@@ -1,0 +1,33 @@
+"""Test environment: CPU backend with 8 virtual devices + float64.
+
+The JAX analog of the reference's single-rank-MPI-stub test trick
+(SURVEY.md section 4): `--xla_force_host_platform_device_count=8` gives an
+8-device mesh without hardware, so every sharding/collective path is exercised
+in CI exactly as it would run on an 8-chip slice. float64 is enabled because
+oracle parity is checked bit-for-bit against the C++ double-precision
+reference (the TPU speed path, by contrast, runs float32).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+
+import pathlib  # noqa: E402
+
+import pytest  # noqa: E402
+
+GOLDENS = pathlib.Path(__file__).resolve().parent.parent / "goldens"
+
+
+@pytest.fixture(scope="session")
+def goldens_dir() -> pathlib.Path:
+    return GOLDENS

@@ -1,0 +1,55 @@
+"""Instance generator vs golden coordinates captured from the reference."""
+
+import json
+
+import numpy as np
+import pytest
+
+from tsp_mpi_reduction_tpu.ops.generator import (
+    generate_instance,
+    get_blocks_per_dim,
+    is_square,
+)
+
+CONFIGS = [
+    "full_10x6_500x500.json",
+    "full_5x10_1000x1000.json",
+    "full_6x15_1000x1000.json",
+    "full_5x50_1000x1000.json",  # grid-spill: 50 blocks -> 2x25 dims
+    "full_3x7_100x100.json",  # prime block count -> 7x1
+    "full_4x9_1000x1000.json",  # perfect square -> 3x3
+    "full_10x10_123x457.json",  # non-square grid dims
+    "full_13x4_1000x1000.json",
+    "full_16x2_1000x1000.json",
+    "full_10x100_1000x1000.json",
+]
+
+
+@pytest.mark.parametrize("name", CONFIGS)
+def test_coords_bit_exact(goldens_dir, name):
+    g = json.loads((goldens_dir / name).read_text())
+    cfg = g["config"]
+    rows, cols = get_blocks_per_dim(cfg["nblocks"])
+    assert [rows, cols] == g["dims"]
+    ids, xy = generate_instance(cfg["ncpb"], cfg["nblocks"], cfg["gx"], cfg["gy"])
+    gold = np.asarray(g["blocks"], dtype=np.float64)  # [B, n, 3] = id, x, y
+    np.testing.assert_array_equal(ids, gold[:, :, 0].astype(np.int32))
+    # bit-exact: zero tolerance
+    np.testing.assert_array_equal(xy[:, :, 0], gold[:, :, 1])
+    np.testing.assert_array_equal(xy[:, :, 1], gold[:, :, 2])
+
+
+def test_grid_spill_quirk(goldens_dir):
+    # 50 blocks factor as 2x25; x coordinates must spill far beyond gridDimX
+    _, xy = generate_instance(5, 50, 1000, 1000)
+    assert xy[:, :, 0].max() > 10000  # 25 * (1000/2) = 12500 nominal max
+    assert xy[:, :, 1].max() <= 1000 + 1e-9
+
+
+def test_blocks_per_dim_factorizations():
+    assert get_blocks_per_dim(9) == (3, 3)
+    assert get_blocks_per_dim(6) == (2, 3)
+    assert get_blocks_per_dim(15) == (3, 5)
+    assert get_blocks_per_dim(7) == (7, 1)  # prime -> p x 1
+    assert get_blocks_per_dim(50) == (2, 25)
+    assert is_square(16) and not is_square(15)

@@ -1,0 +1,28 @@
+"""TPU-native framework with the capabilities of JZHeadley/TSP-MPI-Reduction.
+
+A distributed Euclidean TSP solver: the plane is partitioned into rectangular
+blocks, each block is solved exactly with Held-Karp dynamic programming, and
+block tours are stitched together through a deterministic merge tree with a
+2-opt-style edge-swap operator. The reference (/root/reference, C++/MPI) is the
+behavioral oracle; this package re-designs every component TPU-first:
+
+- blocks are a vmapped batch dimension (reference: one block per MPI message,
+  tsp.cpp:159-195);
+- the Held-Karp table is a dense ``[2^(n-1), n-1]`` HBM array swept by
+  cardinality (reference: ``std::map`` keyed by bitmask, tsp.cpp:405-509);
+- the merge is a broadcasted swap-cost matrix + argmin + gather splice
+  (reference: O(n1*n2) rotate scan, tsp.cpp:202-269);
+- the cross-rank reduction is an on-mesh merge tree under ``shard_map`` with
+  ``ppermute``/``pmin`` collectives (reference: hand-rolled binary-tree
+  MPI_Send/Recv, tsp.cpp:52-134).
+
+Layout:
+    ops/       numerics: glibc-rand replica, instance generator, distance,
+               Held-Karp DP kernel, tour-merge operator
+    models/    solver pipelines: blocked pipeline, branch-and-bound (TSPLIB)
+    parallel/  mesh construction, sharding, distributed merge-tree reduction
+    utils/     CLI compat surface, TSPLIB parser, timing, reporting
+    native/    C++ runtime components (rand, generator, oracle) via ctypes
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,1 @@
+"""Solver pipelines: blocked Held-Karp+merge pipeline, TSPLIB branch-and-bound."""

@@ -1,0 +1,1 @@
+"""Numeric building blocks: RNG replica, instance generator, DP solver, merge."""

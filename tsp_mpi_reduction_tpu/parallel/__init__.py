@@ -1,0 +1,1 @@
+"""Mesh construction, block sharding, and on-mesh merge-tree reduction."""

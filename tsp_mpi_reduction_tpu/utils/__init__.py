@@ -1,0 +1,1 @@
+"""CLI compat surface, TSPLIB parsing, timing, and reporting."""
