@@ -10,7 +10,13 @@ reference (the TPU speed path, by contrast, runs float32).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# NOTE: in this image, sitecustomize imports jax at interpreter startup and
+# registers the remote-TPU ("axon") backend, with JAX_PLATFORMS=axon already
+# in the environment. Env edits here are therefore too late — jax read the
+# env at its (startup) import. Force the platform through jax.config and
+# deregister the axon factory so tests can never touch (or hang on) the
+# remote-TPU tunnel.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -19,7 +25,12 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+from jax._src import xla_bridge as _xb  # noqa: E402
+
+_xb._backend_factories.pop("axon", None)
 
 import pathlib  # noqa: E402
 

@@ -1,0 +1,63 @@
+"""Merge operator + fold vs golden fold costs and final tours."""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tsp_mpi_reduction_tpu.ops.distance import distance_matrix_np
+from tsp_mpi_reduction_tpu.ops.generator import generate_instance
+from tsp_mpi_reduction_tpu.ops.held_karp import solve_blocks_from_dists
+from tsp_mpi_reduction_tpu.ops.merge import PaddedTour, fold_tours, make_padded, merge_tours
+
+CONFIGS = [
+    "full_10x6_500x500.json",
+    "full_5x10_1000x1000.json",
+    "full_6x15_1000x1000.json",
+    "full_5x50_1000x1000.json",
+    "full_3x7_100x100.json",
+    "full_4x9_1000x1000.json",
+    "full_10x10_123x457.json",
+    "full_13x4_1000x1000.json",
+    "full_16x2_1000x1000.json",
+]
+
+
+def setup(goldens_dir, name):
+    g = json.loads((goldens_dir / name).read_text())
+    cfg = g["config"]
+    n, b = cfg["ncpb"], cfg["nblocks"]
+    _, xy = generate_instance(n, b, cfg["gx"], cfg["gy"])
+    dist = jnp.asarray(distance_matrix_np(xy.reshape(-1, 2)))
+    costs, local_tours = solve_blocks_from_dists(distance_matrix_np(xy))
+    global_tours = np.asarray(local_tours) + (np.arange(b)[:, None] * n)
+    return g, n, b, dist, np.asarray(costs), global_tours
+
+
+@pytest.mark.parametrize("name", CONFIGS)
+def test_single_merge_matches_golden_first_fold(goldens_dir, name):
+    g, n, b, dist, costs, tours = setup(goldens_dir, name)
+    if b < 2:
+        pytest.skip("needs >= 2 blocks")
+    cap = 2 * n + 1
+    t1 = make_padded(tours[0], n + 1, jnp.asarray(costs[0]), cap)
+    t2 = make_padded(tours[1], n + 1, jnp.asarray(costs[1]), cap)
+    merged = merge_tours(t1, t2, dist)
+    assert float(merged.cost) == g["fold_costs"][0]
+    assert int(merged.length) == 2 * n + 1
+
+
+@pytest.mark.parametrize("name", CONFIGS)
+def test_fold_final_bit_exact(goldens_dir, name):
+    g, n, b, dist, costs, tours = setup(goldens_dir, name)
+    ids, length, cost = fold_tours(jnp.asarray(tours), jnp.asarray(costs), dist)
+    assert float(cost) == g["final"]["cost"]
+    final_len = int(length)
+    assert final_len == len(g["final"]["ids"])
+    np.testing.assert_array_equal(np.asarray(ids)[:final_len], g["final"]["ids"])
+
+
+def test_merge_rejects_oversized():
+    with pytest.raises(ValueError):
+        make_padded(np.arange(10), 10, 0.0, capacity=5)

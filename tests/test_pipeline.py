@@ -1,0 +1,70 @@
+"""End-to-end pipeline vs golden final costs (the oracle's reported line)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from tsp_mpi_reduction_tpu.models.pipeline import run_pipeline
+
+FAST_CONFIGS = [
+    "full_10x6_500x500.json",
+    "full_5x10_1000x1000.json",
+    "full_6x15_1000x1000.json",
+    "full_5x50_1000x1000.json",
+    "full_3x7_100x100.json",
+    "full_4x9_1000x1000.json",
+    "full_10x10_123x457.json",
+    "full_13x4_1000x1000.json",
+    "full_16x2_1000x1000.json",
+    "full_10x100_1000x1000.json",
+]
+
+SLOW_CONFIGS = [
+    "full_10x200_1000x1000.json",
+    "full_12x100_1000x1000.json",
+    "full_14x100_1000x1000.json",
+    "full_16x100_1000x1000.json",
+    "full_16x200_1000x1000.json",
+]
+
+
+def run_one(goldens_dir, name):
+    g = json.loads((goldens_dir / name).read_text())
+    cfg = g["config"]
+    res = run_pipeline(cfg["ncpb"], cfg["nblocks"], cfg["gx"], cfg["gy"])
+    assert res.cost == g["final"]["cost"], f"{res.cost!r} != {g['final']['cost']!r}"
+    np.testing.assert_array_equal(res.tour_ids, g["final"]["ids"])
+    assert res.num_cities == cfg["ncpb"] * cfg["nblocks"]
+
+
+@pytest.mark.parametrize("name", FAST_CONFIGS)
+def test_pipeline_bit_exact(goldens_dir, name):
+    run_one(goldens_dir, name)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", SLOW_CONFIGS)
+def test_pipeline_bit_exact_slow(goldens_dir, name):
+    run_one(goldens_dir, name)
+
+
+def test_known_make_run_cost(goldens_dir):
+    # `make run` config (Makefile:20): cost 3720.557435 printed by the oracle
+    res = run_pipeline(10, 6, 500, 500)
+    assert f"{res.cost:f}" == "3720.557435"
+
+
+def test_rejects_degenerate_blocks():
+    with pytest.raises(ValueError):
+        run_pipeline(2, 4, 100, 100)
+    with pytest.raises(ValueError):
+        run_pipeline(1, 4, 100, 100)
+    with pytest.raises(ValueError):
+        run_pipeline(5, 0, 100, 100)
+
+
+def test_phase_timings_present():
+    res = run_pipeline(5, 10, 1000, 1000)
+    assert set(res.phase_seconds) == {"generate", "distances", "solve", "merge_fold"}
+    assert res.dp_transitions > 0

@@ -1,0 +1,130 @@
+"""The blocked TSP pipeline: generate -> per-block Held-Karp -> merge fold.
+
+This is the single-controller TPU equivalent of the reference's whole
+``main()`` (tsp.cpp:270-368). Where the reference scatters blocks over MPI
+ranks (tsp.cpp:159-195) and folds per-rank results through a hand-rolled
+message tree (tsp.cpp:52-134), here:
+
+- the instance is *born blocked* as dense arrays (no scatter);
+- all blocks are solved exactly in one vmapped Held-Karp kernel call;
+- the rank-local sequential fold (tsp.cpp:348-352) is a ``lax.scan`` over
+  the merge operator, gathering distances from a resident global matrix.
+
+Single-rank semantics (numProcs=1) are the default and match the oracle
+bit-for-bit in float64; the distributed merge tree over a device mesh lives
+in ``parallel.reduce``.
+
+Deviations from the reference (documented, SURVEY.md quirk #6/#8):
+- blocks of 1-2 cities raise ``ValueError`` instead of yielding an INT_MAX
+  sentinel cost (1 city) or hanging forever in the merge rotate (2 cities);
+- block counts/cities are validated up front instead of producing UB.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.distance import distance_matrix, distance_matrix_np
+from ..ops.generator import generate_instance
+from ..ops.held_karp import build_plan, require_x64_if_float64, solve_blocks_from_dists
+from ..ops.merge import fold_tours
+
+
+@dataclass
+class PipelineResult:
+    """Final solution plus per-phase observability (SURVEY.md §5 rows 1/5)."""
+
+    cost: float
+    tour_ids: np.ndarray  # [final_len] global city ids, closed
+    num_cities: int
+    block_costs: np.ndarray  # [B]
+    phase_seconds: Dict[str, float] = field(default_factory=dict)
+    dp_states: int = 0
+    dp_transitions: int = 0
+
+
+def block_distance_slices(dist: jnp.ndarray, num_blocks: int, n: int) -> jnp.ndarray:
+    """``[N, N]`` global matrix -> ``[B, n, n]`` per-block diagonal slices.
+
+    City ids are sequential block-major (tsp.cpp:390,398), so block b owns
+    the contiguous id range [b*n, (b+1)*n).
+    """
+    r = dist.reshape(num_blocks, n, num_blocks, n)
+    idx = jnp.arange(num_blocks)
+    return r[idx, :, idx, :]
+
+
+def run_pipeline(
+    num_cities_per_block: int,
+    num_blocks: int,
+    grid_dim_x: int,
+    grid_dim_y: int,
+    seed: int = 0,
+    dtype=jnp.float64,
+    xy: Optional[np.ndarray] = None,
+) -> PipelineResult:
+    """Run the full blocked pipeline for one configuration.
+
+    float64 (default) reproduces the single-rank oracle bit-for-bit: the
+    global distance matrix is computed on host (see the FMA note in
+    ``ops.distance``) and every downstream op preserves the oracle's
+    rounding and tie-break order. float32 is the TPU speed mode (distances
+    computed on device).
+
+    ``xy``: optional pre-generated ``[B, n, 2]`` coordinates (skips the
+    generator; used by tests and the distributed driver).
+    """
+    n = num_cities_per_block
+    if n < 3:
+        raise ValueError(
+            f"blocks need >= 3 cities (got {n}): the reference yields an "
+            "INT_MAX sentinel for 1 and hangs for 2 (SURVEY.md quirk #6)"
+        )
+    if num_blocks < 1:
+        raise ValueError(f"need >= 1 block, got {num_blocks}")
+    dtype = jnp.dtype(dtype)
+    require_x64_if_float64(dtype)  # fail fast, before any compute
+    build_plan(n)  # validates the block-size cap up front
+
+    timings: Dict[str, float] = {}
+    t0 = time.perf_counter()
+    if xy is None:
+        _, xy = generate_instance(n, num_blocks, grid_dim_x, grid_dim_y, seed)
+    timings["generate"] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    if dtype == jnp.float64:
+        dist = jnp.asarray(distance_matrix_np(xy.reshape(-1, 2)))
+    else:
+        dist = distance_matrix(jnp.asarray(xy.reshape(-1, 2), dtype))
+    block_d = block_distance_slices(dist, num_blocks, n)
+    timings["distances"] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    costs, local_tours = solve_blocks_from_dists(block_d, dtype)
+    costs.block_until_ready()
+    timings["solve"] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    offsets = (jnp.arange(num_blocks, dtype=jnp.int32) * n)[:, None]
+    global_tours = local_tours.astype(jnp.int32) + offsets
+    ids, length, cost = fold_tours(global_tours, costs, dist)
+    cost.block_until_ready()
+    timings["merge_fold"] = time.perf_counter() - t0
+
+    plan = build_plan(n)
+    final_len = int(length)
+    return PipelineResult(
+        cost=float(cost),
+        tour_ids=np.asarray(ids)[:final_len],
+        num_cities=num_blocks * n,
+        block_costs=np.asarray(costs),
+        phase_seconds=timings,
+        dp_states=plan.dp_states * num_blocks,
+        dp_transitions=plan.dp_transitions * num_blocks,
+    )

@@ -1,0 +1,233 @@
+"""Dense Held-Karp exact TSP solver, designed for the MXU/VPU.
+
+The reference solves each block with Held-Karp DP over a ``std::map`` keyed by
+a (visited-set bitmask, endpoint) pair, with O(log) lookups inside four nested
+loops (tsp.cpp:405-508, assignment2.h:146-182). This module is the TPU-first
+redesign (SURVEY.md §7 step 3):
+
+- the DP table is a dense ``[2^(n-1) + 1, n-1]`` array resident in HBM
+  (array index IS the key: row = visited bitmask over cities 1..n-1, column =
+  endpoint); the ``+1`` row is write-off scratch for padded lanes;
+- masks are processed grouped by popcount (a mask only depends on masks with
+  one fewer bit), so each of the n-2 sequential steps updates every mask of
+  that cardinality as one batched gather + broadcasted min-plus reduction —
+  no data-dependent control flow, fully static shapes under ``jit``;
+- blocks are a ``vmap`` batch dimension (the reference sends one block per
+  MPI message instead, tsp.cpp:159-195);
+- path reconstruction is a ``lax.scan`` over a dense parent-pointer table
+  (the reference stores full path vectors in every map entry).
+
+Semantics notes for oracle parity (verified against goldens):
+
+- The reference's cardinality-2 pass recomputes its seeded states through a
+  missing-key lookup (``operator[]`` default cost 0, tsp.cpp:464), but
+  ``map::insert`` refuses the duplicate keys (tsp.cpp:478), so the seeded
+  values win and the uniform recurrence used here is exact for n >= 3.
+- Ties break toward the smallest predecessor city (the reference's strict
+  ``<`` over ascending ``m``, tsp.cpp:457-471); ``argmin``'s
+  first-occurrence convention matches.
+- Float64 additions occur in the same dependency order as the C++ oracle, so
+  costs are bit-exact; float32 is the TPU speed mode.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .distance import distance_matrix
+
+
+@dataclass(frozen=True)
+class HeldKarpPlan:
+    """Static (trace-time) schedule for one block size ``n``.
+
+    All arrays are host-side numpy, embedded as constants in the jaxpr:
+      scatter_idx  [S, maxNc]     row to write per mask lane (scratch if pad)
+      prev_idx     [S, maxNc, M]  row of the predecessor state per (mask, m)
+      member       [S, maxNc, M]  whether city m is in the mask
+    where S = n-2 cardinality steps, M = n-1, maxNc = max_c C(M, c).
+    """
+
+    n: int
+    scatter_idx: np.ndarray
+    prev_idx: np.ndarray
+    member: np.ndarray
+    dp_states: int  # number of (mask, endpoint) states computed
+    dp_transitions: int  # number of candidate relaxations (the nodes/sec unit)
+
+
+#: Largest supported block size. The reference refuses n > 16 outright
+#: (tsp.cpp:289-295, exit 1337); we allow slight headroom, but beyond 18 the
+#: O(2^n) plan constants and candidate tensors reach multi-GB scale, so the
+#: cap keeps the "fail cleanly up front" promise honest.
+MAX_BLOCK_CITIES = 18
+
+
+@functools.lru_cache(maxsize=None)
+def build_plan(n: int) -> HeldKarpPlan:
+    if not 3 <= n <= MAX_BLOCK_CITIES:
+        raise ValueError(
+            f"Held-Karp block size must be in [3, {MAX_BLOCK_CITIES}], got {n}"
+        )
+    m = n - 1
+    scratch = 1 << m
+    by_card: dict[int, list[int]] = {c: [] for c in range(1, m)}
+    for mask in range(1, 1 << m):
+        c = bin(mask).count("1")
+        if c < m:
+            by_card[c].append(mask)
+    max_nc = max(len(v) for v in by_card.values()) if by_card else 1
+
+    steps = m - 1
+    scatter_idx = np.full((steps, max_nc), scratch, dtype=np.int32)
+    prev_idx = np.full((steps, max_nc, m), scratch, dtype=np.int32)
+    member = np.zeros((steps, max_nc, m), dtype=bool)
+    states = transitions = 0
+    for s, c in enumerate(range(1, m)):
+        masks = by_card[c]
+        for j, mask in enumerate(masks):
+            scatter_idx[s, j] = mask
+            for bit in range(m):
+                if mask & (1 << bit):
+                    prev_idx[s, j, bit] = mask ^ (1 << bit)
+                    member[s, j, bit] = True
+        # endpoints outside the mask get real states; each relaxes over |mask|
+        states += len(masks) * (m - c)
+        transitions += len(masks) * (m - c) * c
+    # closing pass: m states, one relaxation each (tsp.cpp:483-499)
+    states += m
+    transitions += m
+    return HeldKarpPlan(n, scatter_idx, prev_idx, member, states, transitions)
+
+
+def _solve_one(
+    d: jnp.ndarray, plan: HeldKarpPlan, dtype: jnp.dtype
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Solve one block given its ``[n, n]`` distance matrix.
+
+    Returns (cost scalar, closed tour ``[n+1]`` of block-local indices).
+    """
+    n = plan.n
+    m = n - 1
+    scratch = 1 << m
+    inf = jnp.asarray(jnp.inf, dtype)
+
+    d = d.astype(dtype)
+    d_sub = d[1:, 1:]  # distances among cities 1..n-1, indexed 0..m-1
+    d_seed = d[0, 1:]  # city 0 -> i (the reference's distances[0][i])
+    d_back = d[1:, 0]  # i -> city 0 for tour closing
+
+    cost = jnp.full((scratch + 1, m), inf, dtype)
+    cost = cost.at[0].set(d_seed)  # state (visited=empty, endpoint i)
+    parent = jnp.full((scratch + 1, m), -1, jnp.int32)
+
+    d_t = d_sub.T  # d_t[k, m'] = d(m'+1, k+1), the relaxation edge
+
+    def step(carry, xs):
+        cost_t, parent_t = carry
+        sc_idx, pv_idx, mem = xs
+        # g[j, m'] = cost of predecessor state (mask \ {m'}, m')
+        g = cost_t[pv_idx, jnp.arange(m)[None, :]]
+        g = jnp.where(mem, g, inf)
+        cand = g[:, None, :] + d_t[None, :, :]  # [maxNc, k, m']
+        new_cost = jnp.min(cand, axis=-1)
+        new_parent = jnp.argmin(cand, axis=-1).astype(jnp.int32)
+        cost_t = cost_t.at[sc_idx].set(new_cost)
+        parent_t = parent_t.at[sc_idx].set(new_parent)
+        return (cost_t, parent_t), None
+
+    (cost, parent), _ = jax.lax.scan(
+        step,
+        (cost, parent),
+        (
+            jnp.asarray(plan.scatter_idx),
+            jnp.asarray(plan.prev_idx),
+            jnp.asarray(plan.member),
+        ),
+    )
+
+    # close the tour: min over m' of cost[FULL \ {m'}, m'] + d(m'+1, 0)
+    full = (1 << m) - 1
+    close_rows = jnp.asarray(
+        np.array([full ^ (1 << b) for b in range(m)], dtype=np.int32)
+    )
+    totals = cost[close_rows, jnp.arange(m)] + d_back
+    best = jnp.argmin(totals).astype(jnp.int32)
+    final_cost = totals[best]
+
+    # backtrack endpoints via parent pointers (newest-to-oldest)
+    def back(carry, _):
+        mask, end = carry
+        p = parent[mask, end]
+        return (mask & ~(1 << p), p), end
+
+    init = (full ^ (1 << best), best)
+    _, ends = jax.lax.scan(back, init, None, length=m)
+    # tour = [0, oldest .. newest, 0] in city numbering (+1 for city-0 offset)
+    tour = jnp.concatenate(
+        [
+            jnp.zeros((1,), jnp.int32),
+            jnp.flip(ends).astype(jnp.int32) + 1,
+            jnp.zeros((1,), jnp.int32),
+        ]
+    )
+    return final_cost, tour
+
+
+@functools.partial(jax.jit, static_argnames=("n", "dtype"))
+def _solve_blocks_impl(d: jnp.ndarray, n: int, dtype) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    plan = build_plan(n)
+    return jax.vmap(lambda b: _solve_one(b, plan, dtype))(d)
+
+
+def solve_blocks_from_dists(dists, dtype=jnp.float64) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Exactly solve a batch of blocks from ``[B, n, n]`` distance matrices.
+
+    For bit-exact oracle parity, pass host-computed float64 matrices
+    (:func:`..distance.distance_matrix_np`) — see the FMA note there.
+
+    Returns:
+      costs ``[B]`` and closed tours ``[B, n+1]`` of block-local city indices
+      (``tour[0] == tour[-1] == 0``), matching the reference's path layout
+      (tsp.cpp:501-505).
+    """
+    require_x64_if_float64(dtype)
+    dists = jnp.asarray(dists)
+    if dists.ndim != 3 or dists.shape[1] != dists.shape[2]:
+        raise ValueError(f"expected [B, n, n] distance matrices, got {dists.shape}")
+    n = int(dists.shape[1])
+    return _solve_blocks_impl(dists, n, jnp.dtype(dtype))
+
+
+def require_x64_if_float64(dtype) -> None:
+    """Refuse to silently downcast a float64 parity request to float32.
+
+    Without ``jax_enable_x64`` JAX truncates every float64 array to float32
+    with only a warning; downstream tie-breaks then diverge materially from
+    the oracle (not 1-ULP drift). Fail loudly instead.
+    """
+    if jnp.dtype(dtype) == jnp.float64 and not jax.config.jax_enable_x64:
+        raise RuntimeError(
+            "float64 (oracle-parity) mode needs jax_enable_x64: call "
+            'jax.config.update("jax_enable_x64", True) at startup, or pass '
+            "dtype='float32' for TPU speed mode"
+        )
+
+
+def solve_blocks(xy, dtype=jnp.float64) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Exactly solve a batch of blocks from ``[B, n, 2]`` coordinates.
+
+    Distances are computed on device (fast path; 1-ULP FMA caveat vs the
+    oracle — use :func:`solve_blocks_from_dists` with host matrices for
+    bit-exact parity). City 0 anchors the tour, as in the reference.
+    """
+    xy = jnp.asarray(xy)
+    if xy.ndim != 3 or xy.shape[-1] != 2:
+        raise ValueError(f"expected [B, n, 2] coords, got {xy.shape}")
+    return solve_blocks_from_dists(distance_matrix(xy.astype(jnp.dtype(dtype))), dtype)

@@ -1,0 +1,170 @@
+"""Tour-merge operator: broadcasted 2-opt edge-swap on padded tours.
+
+The reference's ``mergeBlocks`` (tsp.cpp:202-269) merges two closed tours by
+scanning every edge pair with a double rotate loop (O(n1*n2) rotations of
+``std::vector``), picking the 2-opt reconnection with minimal
+``swapPairCost`` (tsp.cpp:197-200), then splicing tour 2 *reversed* into
+tour 1 at the chosen edge. This module is the TPU-first redesign (SURVEY.md
+§7 step 4): one broadcasted ``[L1, L2]`` swap-cost matrix gathered from a
+resident distance matrix, a row-major ``argmin``, and a gather-based splice —
+all fixed shapes, vmappable and scannable.
+
+Replicated semantics (bit-exact vs goldens; quirks intentional):
+
+- Edge lists include the zero-length wrap edge ``(tour[L-1], tour[0])`` of
+  the closed representation (the reference's rotate scan walks all ``L``
+  positions including the closing duplicate, tsp.cpp:212-227).
+- Tie-break: first (i, j) in i-major, j-minor order wins (strict ``<`` in the
+  scan; row-major ``argmin`` first-occurrence matches).
+- The merged cost is **formulaic** — ``cost1 + cost2 + bestSwapCost``
+  (tsp.cpp:263) — and the spliced path is never re-measured, so reported
+  cost can differ from true path length (SURVEY.md quirk #4). Reproduced.
+- The splice appends tour 2 reversed after the first city of tour 1 whose id
+  matches either endpoint of the chosen left edge (tsp.cpp:244-259), with
+  tour 2 rotated so the chosen right-edge head lands at the append boundary
+  (tsp.cpp:236-241).
+- Deviation: 2-city tours make the reference's rotate-until-match spin
+  forever (SURVEY.md quirk #6, verified hang). This implementation requires
+  both operands to have >= 3 cities and the caller-facing pipeline rejects
+  ``n < 3`` up front instead of hanging.
+
+Distances are *gathered* from a caller-provided global ``[N, N]`` matrix
+(device-resident; host-computed float64 for oracle parity, see
+``ops.distance.distance_matrix_np``) rather than recomputed per pair.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class PaddedTour(NamedTuple):
+    """A closed tour in a fixed-size buffer.
+
+    ids:   [P] int32 global city ids; entries past ``length`` are padding
+           (kept at 0 — always a valid gather index).
+    length: scalar int32, number of valid entries INCLUDING the closing
+           duplicate (a closed tour of k cities has length k+1).
+    cost:  scalar float, the accumulated (formulaic) tour cost.
+    """
+
+    ids: jnp.ndarray
+    length: jnp.ndarray
+    cost: jnp.ndarray
+
+
+def merge_tours(t1: PaddedTour, t2: PaddedTour, dist: jnp.ndarray) -> PaddedTour:
+    """Merge ``t2`` into ``t1``; result lives in ``t1``-sized buffer.
+
+    Caller must guarantee ``t1.length + t2.length - 1 <= P1`` and both
+    operands hold >= 3 distinct cities (see module docstring).
+    """
+    p1 = t1.ids.shape[0]
+    p2 = t2.ids.shape[0]
+    ids1, len1, c1 = t1.ids, t1.length, t1.cost
+    ids2, len2, c2 = t2.ids, t2.length, t2.cost
+    dtype = dist.dtype
+    inf = jnp.asarray(jnp.inf, dtype)
+
+    i1 = jnp.arange(p1)
+    i2 = jnp.arange(p2)
+    # closed-tour successor: (i+1) mod length, padding lanes clamped to 0
+    nxt1 = jnp.where(i1 + 1 >= len1, 0, i1 + 1)
+    nxt2 = jnp.where(i2 + 1 >= len2, 0, i2 + 1)
+    a = ids1  # left edge heads
+    b = ids1[nxt1]  # left edge tails
+    r1 = ids2  # right edge heads
+    r2 = ids2[nxt2]  # right edge tails
+
+    # swapPairCost (tsp.cpp:197-200), left-to-right addition order:
+    # ((d(a, r2) + d(b, r1)) - d(a, b)) - d(r1, r2)
+    # d(a,b) depends only on i and d(r1,r2) only on j, so gather those once
+    d_ab = dist[a, b]
+    d_r = dist[r1, r2]
+    sc = (
+        dist[a[:, None], r2[None, :]] + dist[b[:, None], r1[None, :]] - d_ab[:, None]
+    ) - d_r[None, :]
+    valid = (i1[:, None] < len1) & (i2[None, :] < len2)
+    sc = jnp.where(valid, sc, inf)
+
+    flat = jnp.argmin(sc.reshape(-1))  # first minimum in i-major, j-minor order
+    i_star = (flat // p2).astype(jnp.int32)
+    j_star = (flat - i_star * p2).astype(jnp.int32)
+    best_swap = sc.reshape(-1)[flat]
+
+    # --- splice (tsp.cpp:229-259) ---
+    l2p = len2 - 1  # tour 2 with its closing duplicate popped
+    p2_rot = jnp.where(j_star >= l2p, 0, j_star)  # index of right-edge head
+    a_id = ids1[i_star]
+    b_id = ids1[jnp.where(i_star + 1 >= len1, 0, i_star + 1)]
+
+    match = ((ids1 == a_id) | (ids1 == b_id)) & (i1 < len1)
+    q = jnp.argmax(match).astype(jnp.int32)  # first matching position
+
+    out_len = len1 + l2p
+    t = jnp.arange(p1)
+    # source-2 positions walk backwards from the right-edge head (reversed
+    # rotated order, tsp.cpp:241-257): rr[u] = ids2[(p2_rot - u) mod l2p]
+    u = t - q - 1
+    src2 = jnp.mod(p2_rot - u, jnp.maximum(l2p, 1))
+    from_t1_head = t <= q
+    from_t2 = (~from_t1_head) & (t <= q + l2p)
+    idx1 = jnp.where(from_t1_head, t, jnp.maximum(t - l2p, 0))
+    out = jnp.where(from_t2, ids2[jnp.clip(src2, 0, p2 - 1)], ids1[jnp.clip(idx1, 0, p1 - 1)])
+    out = jnp.where(t < out_len, out, 0).astype(jnp.int32)
+
+    # formulaic cost (tsp.cpp:263): (cost1 + cost2) + bestSwapCost
+    new_cost = (c1 + c2) + best_swap
+    return PaddedTour(out, out_len, new_cost)
+
+
+def make_padded(ids, length, cost, capacity: int) -> PaddedTour:
+    """Place a tour (global ids, valid ``length``) into a ``capacity`` buffer."""
+    ids = jnp.asarray(ids, jnp.int32)
+    pad = capacity - ids.shape[0]
+    if pad < 0:
+        raise ValueError(f"tour of size {ids.shape[0]} exceeds capacity {capacity}")
+    buf = jnp.pad(ids, (0, pad))
+    lane = jnp.arange(capacity)
+    buf = jnp.where(lane < length, buf, 0)
+    return PaddedTour(buf, jnp.asarray(length, jnp.int32), cost)
+
+
+def fold_tours(
+    tours: jnp.ndarray, costs: jnp.ndarray, dist: jnp.ndarray, capacity: int | None = None
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Sequential left fold of per-block tours, as rank-local merging does.
+
+    Replicates main()'s local reduction (tsp.cpp:348-352): repeatedly merge
+    the accumulated tour with the next block's tour, in block order. Runs as
+    a ``lax.scan`` with the accumulator in a fixed ``capacity`` buffer.
+
+    Args:
+      tours: ``[B, L]`` closed tours of global city ids (L = n+1).
+      costs: ``[B]`` per-tour costs.
+      dist: ``[N, N]`` global distance matrix to gather from.
+      capacity: accumulator buffer size; defaults to the exact final length
+        ``B * (L - 1) + 1``.
+
+    Returns:
+      (ids ``[capacity]``, length scalar, cost scalar) of the folded tour.
+    """
+    tours = jnp.asarray(tours, jnp.int32)
+    costs = jnp.asarray(costs)
+    b, l = tours.shape
+    if capacity is None:
+        capacity = b * (l - 1) + 1
+    acc = make_padded(tours[0], l, costs[0], capacity)
+    if b == 1:
+        return acc.ids, acc.length, acc.cost
+
+    def step(carry, xs):
+        ids2, cost2 = xs
+        t2 = PaddedTour(ids2, jnp.asarray(l, jnp.int32), cost2)
+        return merge_tours(carry, t2, dist), None
+
+    acc, _ = jax.lax.scan(step, acc, (tours[1:], costs[1:]))
+    return acc.ids, acc.length, acc.cost
