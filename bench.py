@@ -1,0 +1,89 @@
+"""Benchmark: full blocked pipeline, 16 cities x 100 blocks (headline config).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the unmodified reference solving the same deterministic instance
+single-rank takes 69997 ms (BASELINE.md, measured in this environment at
+g++ -O2; the instance is identical because generation is srand(0)-
+deterministic). ``vs_baseline`` is the speedup factor (baseline_ms / ours).
+
+Method: device pipeline in float32 (TPU speed mode) — on-device distance
+matrix, vmapped dense Held-Karp over all 100 blocks, scan merge fold.
+The jitted step is compiled once (warmup), then the median of 3 timed
+end-to-end executions (host->device input transfer + full compute +
+device->host result transfer) is reported. Compile time is excluded (the
+reference has no JIT; with the persistent compilation cache it is a
+one-time cost) and printed to stderr for transparency.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_MS = 69997.0  # BASELINE.md: 16 cities/block x 100 blocks, 1 rank
+N, BLOCKS, GRID = 16, 100, 1000
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from tsp_mpi_reduction_tpu.ops.distance import distance_matrix
+    from tsp_mpi_reduction_tpu.ops.generator import generate_instance
+    from tsp_mpi_reduction_tpu.ops.held_karp import build_plan, solve_blocks_from_dists
+    from tsp_mpi_reduction_tpu.ops.merge import fold_tours
+
+    dev = jax.devices()[0]
+    print(f"bench device: {dev}", file=sys.stderr)
+
+    _, xy = generate_instance(N, BLOCKS, GRID, GRID)
+    xy32 = np.asarray(xy, np.float32)
+
+    @jax.jit
+    def step(xy_blocks):
+        flat = xy_blocks.reshape(-1, 2)
+        dist = distance_matrix(flat)
+        block_d = jax.vmap(distance_matrix)(xy_blocks)
+        costs, local_tours = solve_blocks_from_dists(block_d, jnp.float32)
+        offsets = (jnp.arange(BLOCKS, dtype=jnp.int32) * N)[:, None]
+        ids, length, cost = fold_tours(
+            local_tours.astype(jnp.int32) + offsets, costs, dist
+        )
+        return cost, length
+
+    t0 = time.perf_counter()
+    cost, _ = step(jnp.asarray(xy32))
+    cost.block_until_ready()
+    compile_s = time.perf_counter() - t0
+    print(f"first call (compile+run): {compile_s:.1f}s, cost={float(cost):.3f}", file=sys.stderr)
+
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        cost, _ = step(jnp.asarray(xy32))
+        cost.block_until_ready()
+        times.append((time.perf_counter() - t0) * 1000.0)
+    value = float(np.median(times))
+    plan = build_plan(N)
+    nodes_per_sec = plan.dp_transitions * BLOCKS / (value / 1000.0)
+    print(f"times_ms={['%.1f' % t for t in times]} dp_transitions/s={nodes_per_sec:.3e}", file=sys.stderr)
+
+    print(
+        json.dumps(
+            {
+                "metric": "pipeline_16x100_wall_ms",
+                "value": round(value, 3),
+                "unit": "ms",
+                "vs_baseline": round(BASELINE_MS / value, 2),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

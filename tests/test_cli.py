@@ -1,0 +1,65 @@
+"""CLI compat surface: stdout format, exit codes, flags (in-process)."""
+
+import pytest
+
+from tsp_mpi_reduction_tpu.utils import reporting
+from tsp_mpi_reduction_tpu.utils.cli import main
+
+
+def run_cli(capsys, argv):
+    code = main(argv)
+    out = capsys.readouterr()
+    return code, out.out, out.err
+
+
+def test_final_line_format_matches_oracle(capsys):
+    code, out, _ = run_cli(capsys, ["10", "6", "500", "500", "--backend=cpu"])
+    assert code == 0
+    lines = out.strip().split("\n")
+    assert lines[0] == "We have 10 cities for each of our 6 blocks"
+    assert lines[1] == "2 blocks in X 3 in Y"
+    # oracle-identical cost text (golden: make-run config, cost 3720.557435)
+    assert lines[2].startswith("TSP ran in ")
+    assert lines[2].endswith(" ms for 60 cities and the trip cost 3720.557435")
+
+
+def test_wrong_arity_usage_exit1(capsys):
+    code, out, _ = run_cli(capsys, ["10", "6"])
+    assert code == 1
+    assert out.strip() == reporting.usage_line()
+
+
+def test_seventeen_cities_exit_1337(capsys):
+    with pytest.raises(SystemExit) as e:
+        main(["17", "6", "500", "500"])
+    assert e.value.code == 1337  # OS truncates to 57, like the reference
+    assert "retry that with less than 16" in capsys.readouterr().out
+
+
+def test_degenerate_blocks_exit2(capsys):
+    code, _, err = run_cli(capsys, ["2", "6", "500", "500", "--backend=cpu"])
+    assert code == 2
+    assert "3 cities" in err
+
+
+def test_ranks_flag_changes_merge_order(capsys):
+    code1, out1, _ = run_cli(capsys, ["5", "10", "500", "500", "--backend=cpu"])
+    code2, out2, _ = run_cli(
+        capsys, ["5", "10", "500", "500", "--backend=cpu", "--ranks=4"]
+    )
+    assert code1 == code2 == 0
+    cost1 = out1.strip().split()[-1]
+    cost2 = out2.strip().split()[-1]
+    assert cost1 != cost2  # non-associative operator, different tree
+
+
+def test_metrics_flag_emits_json(capsys):
+    import json
+
+    code, _, err = run_cli(
+        capsys, ["5", "10", "500", "500", "--backend=cpu", "--metrics"]
+    )
+    assert code == 0
+    m = json.loads(err.strip().split("\n")[-1])
+    assert m["config"]["numBlocks"] == 10
+    assert m["cost"] > 0

@@ -1,0 +1,113 @@
+"""Mesh-sharded pipeline on the 8-virtual-device CPU mesh.
+
+The JAX analog of multi-rank MPI testing without a cluster (SURVEY.md §4):
+`--xla_force_host_platform_device_count=8` in conftest gives 8 real XLA
+devices, so shard_map + ppermute execute the actual collective code paths.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tsp_mpi_reduction_tpu.models.distributed import run_pipeline_sharded
+from tsp_mpi_reduction_tpu.models.pipeline import run_pipeline
+from tsp_mpi_reduction_tpu.ops.distance import distance_matrix_np
+from tsp_mpi_reduction_tpu.ops.generator import generate_instance
+from tsp_mpi_reduction_tpu.ops.held_karp import solve_blocks_from_dists
+from tsp_mpi_reduction_tpu.ops.merge import PaddedTour, make_padded, merge_tours
+from tsp_mpi_reduction_tpu.parallel.mesh import make_rank_mesh, make_torus_mesh, torus_dims
+from tsp_mpi_reduction_tpu.parallel.reduce import (
+    assign_blocks_to_ranks,
+    rank_block_counts,
+    tree_schedule,
+)
+
+
+def test_rank_block_counts_reference_semantics():
+    # direct emulation of tsp.cpp:167-171
+    for nb, p in [(6, 3), (10, 4), (7, 8), (20, 6), (10, 20)]:
+        expected = [0] * p
+        left = nb
+        while left:
+            expected[left % p] += 1
+            left -= 1
+        assert rank_block_counts(nb, p) == expected
+
+
+def test_tree_schedule_shapes():
+    assert tree_schedule(1) == []
+    assert tree_schedule(2) == [("tree_d0", [(1, 0)])]
+    sched = dict(tree_schedule(6))
+    assert sched["downshift"] == [(4, 0), (5, 1)]
+    assert sched["tree_d0"] == [(1, 0), (3, 2)]
+    assert sched["tree_d1"] == [(2, 0)]
+
+
+def test_torus_dims():
+    assert torus_dims(4) == (2, 2)
+    assert torus_dims(8) == (2, 4)
+    assert torus_dims(7) == (7, 1)
+
+
+def test_single_rank_matches_oracle(goldens_dir):
+    g = json.loads((goldens_dir / "full_10x6_500x500.json").read_text())
+    mesh = make_rank_mesh(1)
+    res = run_pipeline_sharded(10, 6, 500, 500, mesh=mesh)
+    assert res.cost == g["final"]["cost"]
+    np.testing.assert_array_equal(res.tour_ids, g["final"]["ids"])
+
+
+def host_tree_emulation(n, nb, gx, gy, p):
+    """Same tree, same operator, sequentially on one device — the control."""
+    _, xy = generate_instance(n, nb, gx, gy)
+    dist = jnp.asarray(distance_matrix_np(xy.reshape(-1, 2)))
+    costs, local_tours = solve_blocks_from_dists(distance_matrix_np(xy))
+    tours = np.asarray(local_tours) + (np.arange(nb)[:, None] * n)
+    cap = nb * n + 1
+    sols = {}
+    for r, blocks in enumerate(assign_blocks_to_ranks(nb, p)):
+        acc = None
+        for b in blocks:
+            t = make_padded(tours[b], n + 1, jnp.asarray(costs[b]), cap)
+            acc = t if acc is None else merge_tours(acc, t, dist)
+        sols[r] = acc
+    for _name, pairs in tree_schedule(p):
+        for src, dst in pairs:
+            if sols.get(src) is None:
+                continue
+            if sols.get(dst) is None:
+                sols[dst] = sols[src]
+            else:
+                sols[dst] = merge_tours(sols[dst], sols[src], dist)
+            sols[src] = None
+    final = sols[0]
+    return float(final.cost), np.asarray(final.ids)[: int(final.length)]
+
+
+@pytest.mark.parametrize("p", [2, 4, 6, 8])
+def test_sharded_matches_host_emulation(p):
+    n, nb = 5, 12
+    mesh = make_rank_mesh(p)
+    res = run_pipeline_sharded(n, nb, 1000, 1000, mesh=mesh)
+    want_cost, want_ids = host_tree_emulation(n, nb, 1000, 1000, p)
+    assert res.cost == want_cost
+    np.testing.assert_array_equal(res.tour_ids, want_ids)
+    # structural invariants
+    assert res.tour_ids[0] == res.tour_ids[-1]
+    assert sorted(res.tour_ids[:-1]) == list(range(n * nb))
+
+
+def test_idle_ranks():
+    # more ranks than blocks: reference UB territory (SURVEY.md §5); here
+    # idle ranks carry zero-length solutions and the tree still reduces
+    mesh = make_rank_mesh(8)
+    res = run_pipeline_sharded(4, 5, 500, 500, mesh=mesh)
+    assert sorted(res.tour_ids[:-1]) == list(range(20))
+
+
+def test_torus_mesh_runs():
+    mesh = make_torus_mesh(jax.devices()[:4])
+    assert mesh.devices.shape == (2, 2)

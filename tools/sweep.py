@@ -1,0 +1,75 @@
+"""Benchmark sweep: the reference's test.sh, re-hosted.
+
+The reference sweeps cities/block 5-10 x blocks 10..200 step 10 x
+procs 2..20 step 2 on a 1000x1000 grid and greps time/cost from the last
+stdout line into ``results.csv`` with header
+``numCities,numBlocks,numProcs,time,cost`` (test.sh:1-24). This driver
+emits the identical CSV schema, with the ``numProcs`` axis served by the
+rank-emulated merge tree (same assignment, same tree order as a p-rank MPI
+run) so the sweep runs on any machine.
+
+Usage:
+    python tools/sweep.py [--out results.csv] [--quick] [--backend=...]
+                          [--dtype=float64|float32]
+
+``--quick`` restricts to a small config subset (smoke-test mode). The full
+1200-config sweep compiles one XLA program per distinct shape; with the
+persistent compilation cache later sweeps are much faster.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root
+
+from tsp_mpi_reduction_tpu.utils import reporting  # noqa: E402
+from tsp_mpi_reduction_tpu.utils.backend import select_backend  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="results.csv")
+    ap.add_argument("--grid", type=int, default=1000)  # test.sh:2
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--backend", default="auto", choices=["auto", "cpu", "tpu"])
+    ap.add_argument("--dtype", default=None, choices=["float64", "float32"])
+    args = ap.parse_args()
+
+    platform = select_backend(args.backend)
+    dtype = args.dtype or ("float64" if platform == "cpu" else "float32")
+    import jax
+
+    if dtype == "float64":
+        jax.config.update("jax_enable_x64", True)
+
+    from tsp_mpi_reduction_tpu.models.distributed import run_pipeline_ranks
+
+    if args.quick:
+        cities = [5, 8]
+        blocks = [10, 50]
+        procs = [2, 8]
+    else:  # test.sh:5,9,12
+        cities = range(5, 11)
+        blocks = range(10, 201, 10)
+        procs = range(2, 21, 2)
+
+    with open(args.out, "w") as f:
+        f.write(reporting.CSV_HEADER + "\n")
+        for n in cities:
+            for nb in blocks:
+                for p in procs:
+                    t0 = time.perf_counter()
+                    res = run_pipeline_ranks(n, nb, args.grid, args.grid, p, dtype=dtype)
+                    ms = int((time.perf_counter() - t0) * 1000)
+                    row = reporting.csv_row(n, nb, p, ms, res.cost)
+                    print(row)
+                    f.write(row + "\n")
+                    f.flush()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

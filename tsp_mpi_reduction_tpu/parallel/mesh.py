@@ -1,0 +1,57 @@
+"""Device-mesh construction: the ICI-torus analog of MPI_Cart_create.
+
+The reference builds a 2D *torus* communicator over ranks with near-square
+dims and wraparound (``MPI_Cart_create`` with ``wrap={1,1}``, reorder=0,
+tsp.cpp:297-304), using ``getBlocksPerDim(numProcs)`` (tsp.cpp:136-157) for
+the factorization. That is the one place its process shape literally matches
+TPU hardware: the ICI fabric *is* a torus. Here the same factorization lays
+a ``jax.sharding.Mesh`` over the device torus; the reduction itself runs on
+a flattened 1D view (axis "ranks") because the reference never routes by
+coordinates either (coords are computed then unused, tsp.cpp:304-305).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from ..ops.generator import get_blocks_per_dim
+
+RANK_AXIS = "ranks"
+
+
+def torus_dims(num_devices: int) -> Tuple[int, int]:
+    """Near-square 2D factorization, exactly the reference's rank layout."""
+    return get_blocks_per_dim(num_devices)
+
+
+def make_torus_mesh(
+    devices: Optional[Sequence[jax.Device]] = None,
+    axis_names: Tuple[str, str] = ("x", "y"),
+) -> jax.sharding.Mesh:
+    """2D mesh over the device torus (MPI_Cart_create analog)."""
+    devices = list(devices if devices is not None else jax.devices())
+    rows, cols = torus_dims(len(devices))
+    arr = np.asarray(devices).reshape(rows, cols)
+    return jax.sharding.Mesh(arr, axis_names)
+
+
+def make_rank_mesh(
+    num_devices: Optional[int] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> jax.sharding.Mesh:
+    """Flat 1D mesh (axis ``"ranks"``) used by the merge-tree reduction."""
+    if devices is None:
+        devices = jax.devices()
+        if num_devices is not None:
+            if len(devices) < num_devices:
+                raise RuntimeError(
+                    f"asked for a {num_devices}-rank mesh but only "
+                    f"{len(devices)} devices exist; a smaller mesh would "
+                    "silently change the merge-tree result"
+                )
+            devices = devices[:num_devices]
+    arr = np.asarray(list(devices))
+    return jax.sharding.Mesh(arr, (RANK_AXIS,))

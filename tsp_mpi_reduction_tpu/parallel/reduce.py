@@ -1,0 +1,250 @@
+"""On-mesh merge-tree reduction: the collective rewrite of MPI_ManualReduce.
+
+The reference hand-rolls a binary-tree reduction over ranks out of blocking
+point-to-point messages because its operator (``mergeBlocks``) is
+non-commutative, non-associative, and variable-length — builtin
+``MPI_Reduce`` can't express it (tsp.cpp:52-134). Tree shape:
+
+- phase 1 ("downshift", tsp.cpp:72-100): ranks >= lastpower = 2^floor(log2 p)
+  send their solution to ``rank - lastpower``, receiver merges;
+- phase 2 (tsp.cpp:102-132): log2(lastpower) rounds with receiver ``k``,
+  sender ``k + 2^d``, stride ``2^(d+1)``; receiver merges (mine, received).
+
+This module reproduces that exact tree shape on a device mesh: each round is
+one ``lax.ppermute`` over the 1D rank axis under ``shard_map`` — tours ride
+the ICI as fixed-width padded buffers instead of 3-message variable-length
+sequences (count/cities/cost with magic tags, tsp.cpp:109-112). Ranks not
+targeted by a round receive zeros; a zero-length operand means "no data" and
+the combine keeps the local solution, which also covers idle ranks
+(``procNum > numBlocks`` early-exits in the reference, tsp.cpp:326-330).
+
+Deviation (documented): the reference's receive path accumulates received
+cities into a never-cleared vector, so any rank that receives twice merges a
+corrupted operand (SURVEY.md quirk #5). This implementation merges the
+actual operands; single-rank parity (the oracle-verifiable case) is
+unaffected. A byte-parity bug-emulation mode could be added if multi-rank
+MPI goldens ever become capturable (no MPI toolchain exists here).
+
+The scalar-incumbent analog (``lax.pmin`` over the mesh) used by the B&B
+engine lives here too.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from ..ops.merge import PaddedTour, merge_tours
+from .mesh import RANK_AXIS
+
+
+def tree_schedule(num_ranks: int):
+    """The reference's tree as a list of per-round ppermute pairs.
+
+    Returns ``[(round_name, [(src, dst), ...]), ...]`` in execution order.
+    """
+    lastpower = 1 << (num_ranks.bit_length() - 1)
+    if lastpower > num_ranks:
+        lastpower >>= 1
+    rounds = []
+    if num_ranks > lastpower:
+        rounds.append(
+            ("downshift", [(i, i - lastpower) for i in range(lastpower, num_ranks)])
+        )
+    for d in range(int(math.log2(lastpower))):
+        pairs = [(k + (1 << d), k) for k in range(0, lastpower, 1 << (d + 1))]
+        rounds.append((f"tree_d{d}", pairs))
+    return rounds
+
+
+def _combine(mine: PaddedTour, recv: PaddedTour, dist: jnp.ndarray) -> PaddedTour:
+    """Merge ``recv`` into ``mine``; zero-length operands mean "no data"."""
+    merged = merge_tours(mine, recv, dist)
+    keep_mine = recv.length == 0
+    take_recv = (mine.length == 0) & (recv.length > 0)
+
+    def sel(m, r, g):
+        return jnp.where(keep_mine, m, jnp.where(take_recv, r, g))
+
+    return PaddedTour(
+        sel(mine.ids, recv.ids, merged.ids),
+        sel(mine.length, recv.length, merged.length),
+        sel(mine.cost, recv.cost, merged.cost),
+    )
+
+
+def _local_fold(
+    tours: jnp.ndarray, costs: jnp.ndarray, valid: jnp.ndarray, dist: jnp.ndarray, capacity: int
+) -> PaddedTour:
+    """Rank-local sequential fold over (possibly padded-out) blocks.
+
+    The shard_map-local analog of the reference's per-rank loop
+    (tsp.cpp:348-352), with a validity mask so every rank runs the same
+    static scan even when block counts are uneven.
+    """
+    k, l = tours.shape
+
+    def embed(ids, ok):
+        buf = jnp.pad(ids.astype(jnp.int32), (0, capacity - l))
+        return buf * ok.astype(jnp.int32)
+
+    acc = PaddedTour(
+        embed(tours[0], valid[0]),
+        jnp.where(valid[0], l, 0).astype(jnp.int32),
+        jnp.where(valid[0], costs[0], jnp.asarray(0, costs.dtype)),
+    )
+    if k == 1:
+        return acc
+
+    def step(carry, xs):
+        ids2, cost2, ok = xs
+        # merge with the [l]-sized operand (keeps the swap matrix [cap, l]);
+        # the empty/invalid selects happen at carry size
+        t2 = PaddedTour(
+            ids2.astype(jnp.int32), jnp.where(ok, l, 0).astype(jnp.int32), cost2
+        )
+        merged = merge_tours(carry, t2, dist)
+        take_t2 = (carry.length == 0) & ok  # first valid block on this rank
+        keep = ~ok
+
+        def sel(mine, alone, grown):
+            return jnp.where(keep, mine, jnp.where(take_t2, alone, grown))
+
+        nxt = PaddedTour(
+            sel(carry.ids, embed(ids2, ok), merged.ids),
+            sel(carry.length, jnp.asarray(l, jnp.int32), merged.length),
+            sel(carry.cost, cost2, merged.cost),
+        )
+        return nxt, None
+
+    acc, _ = jax.lax.scan(step, acc, (tours[1:], costs[1:], valid[1:]))
+    return acc
+
+
+def reduce_tours_on_mesh(
+    mesh: jax.sharding.Mesh,
+    tours: jnp.ndarray,
+    costs: jnp.ndarray,
+    valid: jnp.ndarray,
+    dist: jnp.ndarray,
+    capacity: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Fold + tree-reduce sharded block solutions down to one global tour.
+
+    Args:
+      mesh: 1D mesh with axis ``"ranks"`` (``make_rank_mesh``).
+      tours: ``[P*K, L]`` per-block closed tours (global city ids), sharded
+        so rank r owns rows [r*K, (r+1)*K) — the reference's block
+        assignment layout (tsp.cpp:173-191).
+      costs: ``[P*K]`` per-block costs.
+      valid: ``[P*K]`` bool, False for padding blocks.
+      dist: ``[N, N]`` global distance matrix (replicated).
+      capacity: padded tour buffer size (>= total tour length).
+
+    Returns:
+      (ids ``[capacity]``, length, cost) of the rank-0 result — the only
+      rank whose value is meaningful, as in the reference (tsp.cpp:133).
+    """
+    num_ranks = mesh.devices.size
+    schedule = tree_schedule(num_ranks)
+
+    def body(tours_blk, costs_blk, valid_blk, dist_rep):
+        acc = _local_fold(tours_blk, costs_blk, valid_blk, dist_rep, capacity)
+        for _name, pairs in schedule:
+            recv = jax.tree.map(
+                lambda x: jax.lax.ppermute(x, RANK_AXIS, pairs), acc
+            )
+            acc = _combine(acc, PaddedTour(*recv), dist_rep)
+        return jax.tree.map(lambda x: x[None], tuple(acc))
+
+    out = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(RANK_AXIS), P(RANK_AXIS), P(RANK_AXIS), P(None, None)),
+        out_specs=P(RANK_AXIS),
+    )(tours, costs, valid, dist)
+    ids, length, cost = out
+    return ids[0], length[0], cost[0]
+
+
+def pmin_incumbent(value: jnp.ndarray, axis_name: str = RANK_AXIS) -> jnp.ndarray:
+    """Broadcast the best (minimum) incumbent across the mesh.
+
+    The collective replacement for the north star's
+    ``MPI_Allreduce(MPI_MIN)`` incumbent sharing: one ``lax.pmin`` riding
+    the ICI instead of a host round-trip.
+    """
+    return jax.lax.pmin(value, axis_name)
+
+
+def tree_reduce_single_device(
+    tours: jnp.ndarray,
+    costs: jnp.ndarray,
+    valid: jnp.ndarray,
+    dist: jnp.ndarray,
+    capacity: int,
+    num_ranks: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Rank-emulated reduction on ONE device: same tree, vmapped rounds.
+
+    Lets any machine reproduce what a p-rank MPI run computes (the merge
+    order, hence the exact result) without p devices — the single-chip
+    analog of the p=1 MPI-stub trick (SURVEY.md §4). Virtual-rank folds run
+    as one vmap over the rank dimension; each tree round is one vmapped
+    pairwise merge over that round's (receiver, sender) pairs.
+    """
+    pk, l = tours.shape
+    if pk % num_ranks:
+        raise ValueError(f"{pk} block slots not divisible by {num_ranks} ranks")
+    k = pk // num_ranks
+    tours_r = tours.reshape(num_ranks, k, l)
+    costs_r = costs.reshape(num_ranks, k)
+    valid_r = valid.reshape(num_ranks, k)
+
+    folds = jax.vmap(lambda t, c, v: _local_fold(t, c, v, dist, capacity))(
+        tours_r, costs_r, valid_r
+    )  # PaddedTour of stacked [P, ...] leaves
+
+    combine_v = jax.vmap(_combine, in_axes=(0, 0, None))
+    for _name, pairs in tree_schedule(num_ranks):
+        src = jnp.asarray([s for s, _ in pairs])
+        dst = jnp.asarray([d for _, d in pairs])
+        mine = jax.tree.map(lambda x: x[dst], folds)
+        recv = jax.tree.map(lambda x: x[src], folds)
+        merged = combine_v(PaddedTour(*mine), PaddedTour(*recv), dist)
+        folds = PaddedTour(
+            *jax.tree.map(lambda x, m: x.at[dst].set(m), tuple(folds), tuple(merged))
+        )
+    return folds.ids[0], folds.length[0], folds.cost[0]
+
+
+def rank_block_counts(num_blocks: int, num_ranks: int) -> list[int]:
+    """Blocks-per-rank, replicating the reference's round-robin countdown.
+
+    ``blocksToSend[blocksLeft % numProcs]++`` for blocksLeft = numBlocks..1
+    (tsp.cpp:167-171): rank r gets #{b in 1..numBlocks : b % numRanks == r}.
+    Rank 0 gets zero blocks when numRanks > numBlocks — the configuration
+    whose empty-solution UB the reference hits (SURVEY.md §5); here idle
+    ranks are first-class (zero-length solutions).
+    """
+    counts = [0] * num_ranks
+    for b in range(1, num_blocks + 1):
+        counts[b % num_ranks] += 1
+    return counts
+
+
+def assign_blocks_to_ranks(num_blocks: int, num_ranks: int) -> list[list[int]]:
+    """Contiguous block index ranges per rank, in the reference's send order
+    (tsp.cpp:173-191: rank 0 keeps the first ``counts[0]`` blocks as
+    leftovers, rank 1 receives the next ``counts[1]``, ...)."""
+    counts = rank_block_counts(num_blocks, num_ranks)
+    out, start = [], 0
+    for c in counts:
+        out.append(list(range(start, start + c)))
+        start += c
+    return out

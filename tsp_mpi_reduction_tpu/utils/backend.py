@@ -1,0 +1,45 @@
+"""Backend selection: the ``--backend={cpu,tpu}`` dispatch surface.
+
+The north star keeps the reference's argv contract and adds a backend
+switch. ``cpu`` forces the host platform (and, in images where a remote-TPU
+plugin is pre-registered at interpreter startup, deregisters it so no jax op
+can hang on an accelerator tunnel); ``tpu`` requires an accelerator
+platform; ``auto`` prefers the accelerator when present.
+"""
+
+from __future__ import annotations
+
+import jax
+
+ACCELERATOR_PLATFORMS = ("tpu", "axon")
+
+
+def _registered_platforms() -> set:
+    from jax._src import xla_bridge as xb
+
+    return set(xb._backend_factories.keys())
+
+
+def select_backend(name: str = "auto") -> str:
+    """Pin the jax platform. Returns the chosen platform name.
+
+    Must run before the first jax array op of the process.
+    """
+    name = name.lower()
+    regs = _registered_platforms()
+    accel = [p for p in ACCELERATOR_PLATFORMS if p in regs]
+    if name == "auto":
+        name = "tpu" if accel else "cpu"
+    if name == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+        from jax._src import xla_bridge as xb
+
+        for p in ACCELERATOR_PLATFORMS:  # never dial a tunnel from CPU mode
+            xb._backend_factories.pop(p, None)
+        return "cpu"
+    if name == "tpu":
+        if not accel:
+            raise RuntimeError("no TPU platform registered in this process")
+        jax.config.update("jax_platforms", ",".join(accel))
+        return "tpu"
+    raise ValueError(f"unknown backend {name!r} (expected cpu|tpu|auto)")

@@ -1,0 +1,139 @@
+"""CLI: the reference's argv contract plus backend/rank/metrics flags.
+
+Compatibility surface (SURVEY.md §7 step 6):
+
+- same 4 positional ints: ``numCitiesPerBlock numBlocks gridDimX gridDimY``
+  (tsp.cpp:282-288);
+- wrong arity -> same usage string, exit 1 (tsp.cpp:280-284);
+- ``numCitiesPerBlock > 16`` -> same scold message, ``exit(1337)``
+  (tsp.cpp:289-295; observed as status 57 = 1337 & 0xFF — same here);
+- stdout: banner line, dims line, and the machine-parsed final line
+  ``TSP ran in <ms> ms for <n> cities and the trip cost <cost>``
+  (tsp.cpp:307,377,363) so ``test.sh``-style scrapers work unchanged.
+
+Extensions (flags, all optional):
+  --backend={auto,cpu,tpu}   device dispatch (north-star ``--backend`` flag)
+  --ranks=P                  emulate a P-rank MPI run (same merge tree)
+  --dtype={float64,float32}  parity vs speed mode
+  --metrics                  print structured JSON metrics line to stderr
+  --seed=S                   instance seed (reference hardwires srand(0))
+
+Deviations: the timer starts at CLI entry rather than before MPI_Init
+(tsp.cpp:276 — there is no MPI to init); degenerate blocks (n < 3) exit 2
+with a clear error instead of the reference's sentinel cost / infinite loop
+(SURVEY.md quirk #6).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from typing import List, Optional
+
+from . import reporting
+from .backend import select_backend
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tsp-tpu",
+        usage=reporting.usage_line(),
+        add_help=True,
+        description="TPU-native blocked TSP solver (JZHeadley/TSP-MPI-Reduction capabilities)",
+    )
+    p.add_argument("numCitiesPerBlock", type=int)
+    p.add_argument("numBlocks", type=int)
+    p.add_argument("gridDimX", type=int)
+    p.add_argument("gridDimY", type=int)
+    p.add_argument("--backend", default="auto", choices=["auto", "cpu", "tpu"])
+    p.add_argument("--ranks", type=int, default=1, metavar="P")
+    p.add_argument("--dtype", default=None, choices=["float64", "float32"])
+    p.add_argument("--metrics", action="store_true")
+    p.add_argument("--seed", type=int, default=0)
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    t_start = time.perf_counter()
+    argv = list(sys.argv[1:] if argv is None else argv)
+
+    try:
+        args = build_parser().parse_args(argv)
+    except SystemExit as e:
+        if e.code in (0, None):  # -h/--help
+            return 0
+        # same behavior as the reference's arity check (tsp.cpp:280-284)
+        print(reporting.usage_line())
+        return 1
+
+    if args.numCitiesPerBlock > 16:
+        print(reporting.too_many_cities_line())
+        sys.exit(1337)  # truncated by the OS to 57, as the reference's is
+
+    platform = select_backend(args.backend)
+    dtype = args.dtype or ("float64" if platform == "cpu" else "float32")
+    import jax
+
+    if dtype == "float64":
+        jax.config.update("jax_enable_x64", True)
+    if platform != "cpu":
+        # persistent compilation cache: repeat invocations skip the slow TPU
+        # compiles. (Not used on CPU: XLA:CPU AOT reload warns about machine
+        # feature mismatches there, and CPU compiles are sub-second anyway.)
+        import os
+
+        cache_dir = os.path.join(
+            os.path.expanduser("~"), ".cache", "tsp_mpi_reduction_tpu", "jax_cache"
+        )
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+
+    from ..models.distributed import run_pipeline_ranks
+    from ..models.pipeline import run_pipeline
+    from ..ops.generator import get_blocks_per_dim
+
+    n, nb = args.numCitiesPerBlock, args.numBlocks
+    print(reporting.banner_line(n, nb))
+    rows, cols = get_blocks_per_dim(nb)
+    print(reporting.dims_line(rows, cols))
+
+    try:
+        if args.ranks > 1:
+            res = run_pipeline_ranks(
+                n, nb, args.gridDimX, args.gridDimY, args.ranks,
+                seed=args.seed, dtype=dtype,
+            )
+        else:
+            res = run_pipeline(
+                n, nb, args.gridDimX, args.gridDimY, seed=args.seed, dtype=dtype
+            )
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    elapsed_ms = int((time.perf_counter() - t_start) * 1000)
+    print(reporting.final_line(elapsed_ms, res.num_cities, res.cost))
+    if args.metrics:
+        print(
+            reporting.metrics_json(
+                config={
+                    "numCitiesPerBlock": n,
+                    "numBlocks": nb,
+                    "gridDimX": args.gridDimX,
+                    "gridDimY": args.gridDimY,
+                    "ranks": args.ranks,
+                    "backend": platform,
+                    "dtype": dtype,
+                },
+                elapsed_ms=elapsed_ms,
+                cost=res.cost,
+                phase_seconds=res.phase_seconds,
+                dp_states=res.dp_states,
+                dp_transitions=res.dp_transitions,
+            ),
+            file=sys.stderr,
+        )
+    return 0
