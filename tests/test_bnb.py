@@ -1,0 +1,73 @@
+"""Branch-and-bound engine: optimality, invariants, checkpointing, sharding."""
+
+import numpy as np
+import pytest
+
+from tsp_mpi_reduction_tpu.models import branch_bound as bb
+from tsp_mpi_reduction_tpu.ops.held_karp import solve_blocks_from_dists
+from tsp_mpi_reduction_tpu.parallel.mesh import make_rank_mesh
+from tsp_mpi_reduction_tpu.utils.tsplib import burma14
+
+
+def random_d(n, seed):
+    xy = np.random.default_rng(seed).uniform(0, 100, (n, 2))
+    return np.sqrt(((xy[:, None] - xy[None]) ** 2).sum(-1))
+
+
+def test_matches_held_karp_random():
+    for seed in (0, 1):
+        d = random_d(12, seed)
+        hk, _ = solve_blocks_from_dists(d[None])
+        res = bb.solve(d, capacity=1 << 14, k=64)
+        assert res.proven_optimal
+        assert abs(res.cost - float(hk[0])) < 1e-3
+        # reported tour measures to the reported cost
+        assert abs(bb.tour_cost(d, res.tour) - res.cost) < 1e-3
+        assert sorted(res.tour[:-1].tolist()) == list(range(12))
+
+
+@pytest.mark.slow
+def test_burma14_proven_optimal():
+    d = burma14().distance_matrix()
+    res = bb.solve(d, capacity=1 << 15, k=128)
+    assert res.cost == 3323.0 and res.proven_optimal
+    assert res.nodes_expanded > 0 and res.nodes_per_sec > 0
+
+
+@pytest.mark.slow
+def test_sharded_burma14(goldens_dir):
+    d = burma14().distance_matrix()
+    res = bb.solve_sharded(d, make_rank_mesh(8), capacity_per_rank=1 << 14, k=64)
+    assert res.cost == 3323.0 and res.proven_optimal
+
+
+def test_checkpoint_resume(tmp_path):
+    d = random_d(11, 3)
+    ckpt = str(tmp_path / "bnb.npz")
+    partial = bb.solve(d, capacity=1 << 13, k=32, inner_steps=4, max_iters=8,
+                       checkpoint_path=ckpt, checkpoint_every=4)
+    assert not partial.proven_optimal  # stopped early
+    resumed = bb.solve(d, capacity=1 << 13, k=32, resume_from=ckpt)
+    hk, _ = solve_blocks_from_dists(d[None])
+    assert resumed.proven_optimal
+    assert abs(resumed.cost - float(hk[0])) < 1e-3
+
+
+def test_greedy_init_tools():
+    d = random_d(20, 5)
+    nn = bb.nearest_neighbor_tour(d)
+    assert sorted(nn[:-1].tolist()) == list(range(20))
+    improved = bb.two_opt(d, nn)
+    assert bb.tour_cost(d, improved) <= bb.tour_cost(d, nn) + 1e-9
+    assert sorted(improved[:-1].tolist()) == list(range(20))
+
+
+def test_rejects_large_n():
+    with pytest.raises(ValueError):
+        bb.solve(np.ones((33, 33)))
+
+
+def test_target_cost_early_stop():
+    d = random_d(12, 4)
+    res = bb.solve(d, capacity=1 << 14, k=64, target_cost=1e9)
+    assert res.iterations <= 64  # stops on first sync at target

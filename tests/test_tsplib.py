@@ -1,0 +1,78 @@
+"""TSPLIB parser + metrics + embedded burma14 fixture."""
+
+import numpy as np
+import pytest
+
+from tsp_mpi_reduction_tpu.ops.held_karp import solve_blocks_from_dists
+from tsp_mpi_reduction_tpu.utils import tsplib
+
+
+def test_burma14_fixture_self_validates():
+    inst = tsplib.burma14()
+    assert inst.dimension == 14 and inst.edge_weight_type == "GEO"
+    d = inst.distance_matrix()
+    assert d.shape == (14, 14) and (d == d.T).all()
+    # the optimum is re-derived exactly, not assumed
+    costs, _ = solve_blocks_from_dists(d[None].astype(np.float64))
+    assert float(costs[0]) == inst.known_optimum == 3323
+
+
+def test_euc2d_parse_and_metric():
+    text = """NAME: toy
+TYPE: TSP
+DIMENSION: 3
+EDGE_WEIGHT_TYPE: EUC_2D
+NODE_COORD_SECTION
+1 0.0 0.0
+2 3.0 4.0
+3 0.0 10.5
+EOF
+"""
+    inst = tsplib.parse(text)
+    d = inst.distance_matrix()
+    assert d[0, 1] == 5  # nint(5.0)
+    assert d[0, 2] == 11  # nint(10.5) = floor(11.0)
+
+
+def test_explicit_full_matrix():
+    text = """NAME: m3
+TYPE: TSP
+DIMENSION: 3
+EDGE_WEIGHT_TYPE: EXPLICIT
+EDGE_WEIGHT_FORMAT: FULL_MATRIX
+EDGE_WEIGHT_SECTION
+0 2 3
+2 0 4
+3 4 0
+EOF
+"""
+    d = tsplib.parse(text).distance_matrix()
+    assert d.tolist() == [[0, 2, 3], [2, 0, 4], [3, 4, 0]]
+
+
+def test_explicit_upper_row():
+    text = """NAME: u3
+TYPE: TSP
+DIMENSION: 3
+EDGE_WEIGHT_TYPE: EXPLICIT
+EDGE_WEIGHT_FORMAT: UPPER_ROW
+EDGE_WEIGHT_SECTION
+2 3
+4
+EOF
+"""
+    d = tsplib.parse(text).distance_matrix()
+    assert d.tolist() == [[0, 2, 3], [2, 0, 4], [3, 4, 0]]
+
+
+def test_att_metric():
+    c = np.array([[0.0, 0.0], [10.0, 0.0]])
+    d = tsplib._att(c)
+    # r = sqrt(100/10) = 3.162..; nint -> 3 < r -> 4
+    assert d[0, 1] == 4
+
+
+def test_ceil_metric():
+    c = np.array([[0.0, 0.0], [3.0, 4.1]])
+    d = tsplib._ceil_2d(c)
+    assert d[0, 1] == 6  # ceil(5.08..)

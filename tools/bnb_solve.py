@@ -1,0 +1,103 @@
+"""TSPLIB branch-and-bound driver: nodes/sec + time-to-optimal reporting.
+
+The north-star benchmark surface (BASELINE.json metric: "B&B nodes/sec +
+time-to-optimal"). Solves a TSPLIB instance (file path or the embedded
+``burma14``) exactly and prints a JSON metrics line.
+
+Usage:
+    python tools/bnb_solve.py burma14 [--backend=...] [--ranks=N]
+    python tools/bnb_solve.py path/to/berlin52.tsp --time-limit=60
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from tsp_mpi_reduction_tpu.utils.backend import select_backend  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("instance", help="TSPLIB .tsp path or 'burma14'")
+    ap.add_argument("--backend", default="auto", choices=["auto", "cpu", "tpu"])
+    ap.add_argument("--ranks", type=int, default=1)
+    ap.add_argument("--k", type=int, default=256)
+    ap.add_argument("--capacity", type=int, default=1 << 17)
+    ap.add_argument("--inner-steps", type=int, default=32)
+    ap.add_argument("--time-limit", type=float, default=None)
+    ap.add_argument("--checkpoint", default=None)
+    ap.add_argument("--checkpoint-every", type=int, default=0)
+    ap.add_argument("--resume", default=None)
+    args = ap.parse_args()
+
+    select_backend(args.backend)
+
+    from tsp_mpi_reduction_tpu.models import branch_bound as bb
+    from tsp_mpi_reduction_tpu.utils import tsplib
+
+    if args.instance == "burma14":
+        inst = tsplib.burma14()
+    else:
+        try:
+            inst = tsplib.load(args.instance)
+        except OSError as e:
+            print(f"error: cannot read instance: {e}", file=sys.stderr)
+            return 2
+    d = inst.distance_matrix()
+
+    if args.ranks > 1:
+        if args.checkpoint or args.resume:
+            print(
+                "warning: --checkpoint/--resume are not supported with "
+                "--ranks > 1 yet and will be ignored",
+                file=sys.stderr,
+            )
+        from tsp_mpi_reduction_tpu.parallel.mesh import make_rank_mesh
+
+        res = bb.solve_sharded(
+            d,
+            make_rank_mesh(args.ranks),
+            capacity_per_rank=args.capacity // args.ranks,
+            k=args.k,
+            inner_steps=args.inner_steps,
+            time_limit_s=args.time_limit,
+        )
+    else:
+        res = bb.solve(
+            d,
+            capacity=args.capacity,
+            k=args.k,
+            inner_steps=args.inner_steps,
+            time_limit_s=args.time_limit,
+            checkpoint_path=args.checkpoint,
+            checkpoint_every=args.checkpoint_every,
+            resume_from=args.resume,
+        )
+
+    opt = inst.known_optimum
+    print(
+        json.dumps(
+            {
+                "instance": inst.name,
+                "dimension": inst.dimension,
+                "cost": res.cost,
+                "known_optimum": opt,
+                "optimal": (res.cost == opt) if opt is not None else None,
+                "proven_optimal": res.proven_optimal,
+                "nodes_expanded": res.nodes_expanded,
+                "nodes_per_sec": round(res.nodes_per_sec, 1),
+                "time_to_best_s": round(res.time_to_best, 4),
+                "wall_s": round(res.wall_seconds, 3),
+                "ranks": args.ranks,
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,510 @@
+"""Branch-and-bound TSP engine: padded device frontier + vmapped expansion.
+
+The north star (BASELINE.json) describes the target architecture: "the
+per-rank subtree expansion becomes a vmap'd batched partial-tour evaluator,
+and the MPI_Allreduce(MPI_MIN) that broadcasts the incumbent becomes
+jax.lax.pmin over the ICI mesh ... with the distance matrix held read-only
+in HBM and the B&B frontier kept as a padded device array." The reference
+itself contains no B&B (SURVEY.md §0 discrepancy note) — this engine is the
+north-star extension built on the same framework substrate.
+
+Design (TPU-first):
+
+- The frontier is a fixed-capacity struct-of-arrays stack in HBM
+  (prefix paths, visited bitmasks, costs, bounds, validity, count).
+- One ``expand_step`` jit pops the top K nodes (LIFO -> depth-first memory
+  behavior), expands all K*n children as a single vmapped evaluation
+  against the resident distance matrix, prunes against the incumbent,
+  detects completed tours, and pushes survivors sorted worst-bound-first so
+  the next pop explores best-bound-first. No data-dependent shapes: pruned
+  lanes are masked, the push uses a prefix-sum scatter.
+- Admissible lower bound: ``cost + min_out[cur] + sum(min_out[unvisited])``
+  (every city still to be left contributes at least its cheapest outgoing
+  edge). The running ``sum(min_out[unvisited])`` is carried in the state so
+  the child bound is one add.
+- The incumbent starts from a host-side nearest-neighbor + 2-opt tour, so
+  pruning is strong from step one.
+- The host loop only reads back two scalars per iteration (frontier count,
+  incumbent) — the expansion itself never syncs.
+- Multi-rank: ``expand_step`` composes with ``shard_map`` by giving each
+  rank its own frontier shard and sharing the incumbent with ``lax.pmin``
+  (``parallel.reduce.pmin_incumbent``); see ``solve_sharded``.
+- Checkpoint/resume: the frontier + incumbent are plain arrays; ``save``/
+  ``restore`` round-trips them through an .npz (SURVEY.md §5 checkpoint
+  row: incumbent + frontier give restart for long runs).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from functools import partial
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+INF = jnp.inf
+
+
+class Frontier(NamedTuple):
+    path: jnp.ndarray  # [F, n] int32 city prefix (undefined past depth)
+    mask: jnp.ndarray  # [F] uint32 visited bitmask
+    depth: jnp.ndarray  # [F] int32
+    cost: jnp.ndarray  # [F] float32 prefix cost
+    bound: jnp.ndarray  # [F] float32 admissible lower bound
+    sum_min: jnp.ndarray  # [F] float32 sum of min_out over unvisited
+    count: jnp.ndarray  # scalar int32: stack height
+    overflow: jnp.ndarray  # scalar bool: capacity was exceeded (exactness lost)
+
+
+@dataclass
+class BnBResult:
+    cost: float
+    tour: np.ndarray  # [n+1] closed tour of city indices, starts/ends at 0
+    nodes_expanded: int
+    iterations: int
+    proven_optimal: bool
+    wall_seconds: float
+    nodes_per_sec: float
+    time_to_best: float
+
+
+def nearest_neighbor_tour(d: np.ndarray) -> np.ndarray:
+    n = d.shape[0]
+    visited = np.zeros(n, bool)
+    tour = [0]
+    visited[0] = True
+    for _ in range(n - 1):
+        cur = tour[-1]
+        cand = np.where(visited, np.inf, d[cur])
+        nxt = int(np.argmin(cand))
+        tour.append(nxt)
+        visited[nxt] = True
+    return np.asarray(tour + [0], dtype=np.int32)
+
+
+def two_opt(d: np.ndarray, tour: np.ndarray, max_rounds: int = 200) -> np.ndarray:
+    """Host-side best-improvement 2-opt (vectorized delta matrix)."""
+    t = tour[:-1].copy()  # open tour, implicit return edge
+    n = len(t)
+    for _ in range(max_rounds):
+        pos = np.concatenate([t, t[:1]])
+        a, b = pos[:-1], pos[1:]  # edges (a_i, b_i)
+        # delta of reversing segment between edge i and edge j (i < j):
+        # d[a_i, a_j] + d[b_i, b_j] - d[a_i, b_i] - d[a_j, b_j]
+        da = d[a[:, None], a[None, :]] + d[b[:, None], b[None, :]]
+        db = d[a, b][:, None] + d[a, b][None, :]
+        delta = da - db
+        iu = np.triu_indices(n, k=2)
+        flat = delta[iu]
+        k = int(np.argmin(flat))
+        if flat[k] >= -1e-9:
+            break
+        i, j = iu[0][k], iu[1][k]
+        t[i + 1 : j + 1] = t[i + 1 : j + 1][::-1]
+    return np.concatenate([t, t[:1]]).astype(np.int32)
+
+
+def tour_cost(d: np.ndarray, tour: np.ndarray) -> float:
+    return float(d[tour[:-1], tour[1:]].sum())
+
+
+@partial(jax.jit, static_argnames=("k", "n"))
+def _expand_step(
+    fr: Frontier,
+    inc_cost: jnp.ndarray,
+    inc_tour: jnp.ndarray,
+    d: jnp.ndarray,
+    min_out: jnp.ndarray,
+    k: int,
+    n: int,
+):
+    """Pop <=K nodes, expand, prune, push. Returns (frontier', inc', stats)."""
+    f_cap = fr.path.shape[0]
+    lanes = jnp.arange(k, dtype=jnp.int32)
+    # pop the top-of-stack K entries (stack grows upward)
+    take = jnp.minimum(fr.count, k)
+    idx = jnp.maximum(fr.count - 1 - lanes, 0)  # top-first
+    live = lanes < take
+
+    p_path = fr.path[idx]
+    p_mask = fr.mask[idx]
+    p_depth = fr.depth[idx]
+    p_cost = fr.cost[idx]
+    p_sum = fr.sum_min[idx]
+    cur = p_path[lanes, jnp.maximum(p_depth - 1, 0)]
+
+    cities = jnp.arange(n, dtype=jnp.int32)
+    unvis = (p_mask[:, None] >> cities[None, :].astype(jnp.uint32)) & 1 == 0
+    feasible = unvis & live[:, None]
+    ccost = p_cost[:, None] + d[cur]  # d[cur] is the [k, n] outgoing-edge block
+    # child bound: ccost + sum over must-leave cities (child + remaining)
+    cbound = ccost + p_sum[:, None]
+    cdepth = p_depth[:, None] + 1
+
+    # completions: child is the last unvisited city -> close to 0
+    is_complete = (cdepth == n) & feasible
+    total = ccost + d[cities, 0][None, :]
+    comp_total = jnp.where(is_complete, total, INF)
+    best_flat = jnp.argmin(comp_total.reshape(-1))
+    best_total = comp_total.reshape(-1)[best_flat]
+    bi = (best_flat // n).astype(jnp.int32)
+    bc = (best_flat % n).astype(jnp.int32)
+    new_inc_cost = jnp.minimum(inc_cost, best_total)
+    best_path = p_path[bi].at[jnp.minimum(p_depth[bi], n - 1)].set(bc)
+    # closed tour layout [n+1]: prefix + final city + return-to-0
+    cand_tour = jnp.zeros(n + 1, jnp.int32).at[:n].set(best_path)
+    new_inc_tour = jnp.where(best_total < inc_cost, cand_tour, inc_tour)
+
+    # pushable children: feasible, not complete, bound under incumbent
+    push = feasible & ~is_complete & (cbound < new_inc_cost)
+    child_mask = p_mask[:, None] | (jnp.uint32(1) << cities[None, :].astype(jnp.uint32))
+    child_sum = p_sum[:, None] - min_out[None, :]
+    child_path = jnp.broadcast_to(p_path[:, None, :], (k, n, n))
+    child_path = jnp.where(
+        (jnp.arange(n)[None, None, :] == jnp.minimum(p_depth[:, None, None], n - 1)),
+        cities[None, :, None],
+        child_path,
+    )
+
+    # flatten and order pushes by bound DESC so the stack top is best-first
+    flat_push = push.reshape(-1)
+    flat_bound = jnp.where(flat_push, cbound.reshape(-1), -INF)
+    order = jnp.argsort(-flat_bound)  # pushable (largest first), then -inf pad
+    flat_push_o = flat_push[order]
+    n_push = flat_push_o.sum()
+
+    base = fr.count - take
+    dest = base + jnp.cumsum(flat_push_o.astype(jnp.int32)) - 1
+    dest = jnp.where(flat_push_o, dest, f_cap)  # parked lanes scatter off-end
+    dest = jnp.minimum(dest, f_cap)  # scatter drop mode ignores off-end
+
+    def scat(buf, vals):
+        return buf.at[dest].set(vals[order], mode="drop")
+
+    new_path = scat(fr.path, child_path.reshape(-1, n))
+    new_mask = scat(fr.mask, child_mask.reshape(-1))
+    new_depth = scat(fr.depth, jnp.broadcast_to(cdepth, (k, n)).reshape(-1))
+    new_cost = scat(fr.cost, ccost.reshape(-1))
+    new_bound = scat(fr.bound, cbound.reshape(-1))
+    new_sum = scat(fr.sum_min, child_sum.reshape(-1))
+
+    new_count = base + n_push.astype(jnp.int32)
+    overflow = fr.overflow | (new_count > f_cap)
+    new_count = jnp.minimum(new_count, f_cap)
+
+    stats = {"popped": take, "pushed": n_push, "completions": is_complete.sum()}
+    return (
+        Frontier(new_path, new_mask, new_depth, new_cost, new_bound, new_sum, new_count, overflow),
+        new_inc_cost,
+        new_inc_tour,
+        stats,
+    )
+
+
+@partial(jax.jit, static_argnames=("k", "n", "inner_steps"))
+def _expand_loop(
+    fr: Frontier,
+    inc_cost: jnp.ndarray,
+    inc_tour: jnp.ndarray,
+    d: jnp.ndarray,
+    min_out: jnp.ndarray,
+    k: int,
+    n: int,
+    inner_steps: int,
+):
+    """Run up to ``inner_steps`` expansion steps in ONE device program.
+
+    The host only syncs once per call — essential on TPU, where a per-step
+    host round-trip would dominate the (microseconds) expansion kernel.
+    """
+
+    def cond(carry):
+        fr, _, _, _, i = carry
+        return (i < inner_steps) & (fr.count > 0)
+
+    def body(carry):
+        fr, ic, itour, nodes, i = carry
+        fr, ic, itour, stats = _expand_step(fr, ic, itour, d, min_out, k, n)
+        return fr, ic, itour, nodes + stats["popped"], i + 1
+
+    # derive the zero carries from fr.count so their varying-axis type
+    # matches the body outputs under shard_map (see shard_map vma docs)
+    zero = fr.count * 0
+    fr, inc_cost, inc_tour, nodes, _ = jax.lax.while_loop(
+        cond, body, (fr, inc_cost, inc_tour, zero, zero)
+    )
+    return fr, inc_cost, inc_tour, nodes
+
+
+def make_root_frontier(n: int, capacity: int, min_out: np.ndarray, dtype=jnp.float32) -> Frontier:
+    path = jnp.zeros((capacity, n), jnp.int32)
+    mask = jnp.zeros(capacity, jnp.uint32).at[0].set(1)  # city 0 visited
+    depth = jnp.zeros(capacity, jnp.int32).at[0].set(1)
+    cost = jnp.zeros(capacity, dtype)
+    bound = jnp.zeros(capacity, dtype)
+    sum_min = jnp.zeros(capacity, dtype).at[0].set(float(min_out[1:].sum()))
+    return Frontier(
+        path, mask, depth, cost, bound, sum_min,
+        jnp.asarray(1, jnp.int32), jnp.asarray(False),
+    )
+
+
+def solve(
+    d: np.ndarray,
+    capacity: int = 1 << 17,
+    k: int = 256,
+    inner_steps: int = 32,
+    max_iters: int = 200_000,
+    time_limit_s: Optional[float] = None,
+    target_cost: Optional[float] = None,
+    checkpoint_path: Optional[str] = None,
+    checkpoint_every: int = 0,
+    resume_from: Optional[str] = None,
+) -> BnBResult:
+    """Exact B&B on one device. ``d`` is a dense [n, n] distance matrix.
+
+    Stops when the frontier empties (proven optimal), or at
+    ``max_iters``/``time_limit_s``/``target_cost`` (then best-so-far).
+    """
+    n = d.shape[0]
+    if n > 32:
+        # visited sets are uint32 bitmasks
+        raise ValueError(f"B&B engine supports n <= 32 cities, got {n}")
+    d32 = jnp.asarray(d, jnp.float32)
+    min_out_np = np.where(np.eye(n, dtype=bool), np.inf, np.asarray(d, np.float64)).min(1)
+    min_out = jnp.asarray(min_out_np, jnp.float32)
+
+    if resume_from:
+        fr, inc_cost, inc_tour = restore(resume_from, expect_d=d)
+    else:
+        inc_tour_np = two_opt(
+            np.asarray(d, np.float64), nearest_neighbor_tour(np.asarray(d))
+        )
+        inc_cost = jnp.asarray(
+            tour_cost(np.asarray(d, np.float64), inc_tour_np), jnp.float32
+        )
+        inc_tour = jnp.asarray(inc_tour_np, jnp.int32)
+        fr = make_root_frontier(n, capacity, min_out_np)
+
+    t0 = time.perf_counter()
+    t_best = 0.0
+    last_inc = float(inc_cost)
+    nodes = 0
+    it = 0
+    inner = max(1, inner_steps)
+    while it < max_iters:
+        fr, inc_cost, inc_tour, popped = _expand_loop(
+            fr, inc_cost, inc_tour, d32, min_out, k, n, inner
+        )
+        nodes += int(popped)
+        it += inner
+        cnt = int(fr.count)
+        ic = float(inc_cost)
+        if ic < last_inc:
+            last_inc = ic
+            t_best = time.perf_counter() - t0
+        if checkpoint_every and checkpoint_path and it % max(checkpoint_every, inner) < inner:
+            save(checkpoint_path, fr, inc_cost, inc_tour, d=d)
+        if cnt == 0:
+            break
+        if time_limit_s is not None and time.perf_counter() - t0 > time_limit_s:
+            break
+        if target_cost is not None and ic <= target_cost:
+            break
+    wall = time.perf_counter() - t0
+    proven = int(fr.count) == 0 and not bool(fr.overflow)
+    if checkpoint_path and not proven:
+        # always leave a resumable snapshot when stopping early (time limit,
+        # iteration cap, target reached)
+        save(checkpoint_path, fr, inc_cost, inc_tour, d=d)
+    return BnBResult(
+        cost=float(inc_cost),
+        tour=np.asarray(inc_tour),
+        nodes_expanded=nodes,
+        iterations=it,
+        proven_optimal=proven,
+        wall_seconds=wall,
+        nodes_per_sec=nodes / wall if wall > 0 else 0.0,
+        time_to_best=t_best,
+    )
+
+
+def solve_sharded(
+    d: np.ndarray,
+    mesh,
+    capacity_per_rank: int = 1 << 15,
+    k: int = 128,
+    inner_steps: int = 32,
+    max_iters: int = 200_000,
+    time_limit_s: Optional[float] = None,
+) -> BnBResult:
+    """Mesh-parallel B&B: per-rank frontiers, collective incumbent sharing.
+
+    The north star's architecture realized: each rank expands its own
+    padded frontier shard (seeded with a round-robin split of the root's
+    children), and after every inner batch the incumbent cost/tour is
+    shared across the mesh with ``all_gather`` + argmin — the collective
+    form of the reference-era ``MPI_Allreduce(MPI_MIN)`` incumbent
+    broadcast, riding the ICI. Work stays static per rank this round
+    (no stealing); idle ranks simply run empty loops.
+    """
+    from jax import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.mesh import RANK_AXIS
+
+    n = d.shape[0]
+    if n > 32:
+        raise ValueError(f"B&B engine supports n <= 32 cities, got {n}")
+    num_ranks = int(mesh.devices.size)
+    d32 = jnp.asarray(d, jnp.float32)
+    d_np = np.asarray(d, np.float64)
+    min_out_np = np.where(np.eye(n, dtype=bool), np.inf, d_np).min(1)
+    min_out = jnp.asarray(min_out_np, jnp.float32)
+
+    inc_tour_np = two_opt(d_np, nearest_neighbor_tour(d_np))
+    inc_cost0 = tour_cost(d_np, inc_tour_np)
+
+    # seed: depth-2 children of the root, round-robin over ranks
+    sum_min0 = float(min_out_np[1:].sum())
+    leaves = {f: [] for f in Frontier._fields}
+    for r in range(num_ranks):
+        path = np.zeros((capacity_per_rank, n), np.int32)
+        mask = np.zeros(capacity_per_rank, np.uint32)
+        depth = np.zeros(capacity_per_rank, np.int32)
+        cost = np.zeros(capacity_per_rank, np.float32)
+        bound = np.zeros(capacity_per_rank, np.float32)
+        sum_min = np.zeros(capacity_per_rank, np.float32)
+        mine = [c for c in range(1, n) if (c - 1) % num_ranks == r]
+        for slot, c in enumerate(mine):
+            path[slot, 0] = 0
+            path[slot, 1] = c
+            mask[slot] = np.uint32(1 | (1 << c))
+            depth[slot] = 2
+            cost[slot] = d_np[0, c]
+            bound[slot] = d_np[0, c] + sum_min0
+            sum_min[slot] = sum_min0 - min_out_np[c]
+        leaves["path"].append(path)
+        leaves["mask"].append(mask)
+        leaves["depth"].append(depth)
+        leaves["cost"].append(cost)
+        leaves["bound"].append(bound)
+        leaves["sum_min"].append(sum_min)
+        leaves["count"].append(np.int32(len(mine)))
+        leaves["overflow"].append(False)
+    spec = NamedSharding(mesh, P(RANK_AXIS))
+    fr = Frontier(*(jax.device_put(np.stack(leaves[f]), spec) for f in Frontier._fields))
+    ic = jax.device_put(np.full(num_ranks, inc_cost0, np.float32), spec)
+    itour = jax.device_put(
+        np.broadcast_to(inc_tour_np, (num_ranks, n + 1)).copy(), spec
+    )
+
+    def rank_body(fr_stacked, ic_l, itour_l, d_rep, mo_rep):
+        local = Frontier(*(x[0] for x in fr_stacked))
+        f2, c2, t2, nodes = _expand_loop(
+            local, ic_l[0], itour_l[0], d_rep, mo_rep, k, n, inner_steps
+        )
+        all_c = jax.lax.all_gather(c2, RANK_AXIS)
+        all_t = jax.lax.all_gather(t2, RANK_AXIS)
+        b = jnp.argmin(all_c)
+        total = jax.lax.psum(f2.count, RANK_AXIS)
+        total_nodes = jax.lax.psum(nodes, RANK_AXIS)
+        return (
+            jax.tree.map(lambda x: x[None], tuple(f2)),
+            all_c[b][None],
+            all_t[b][None],
+            total[None],
+            total_nodes[None],
+        )
+
+    step = jax.jit(
+        shard_map(
+            rank_body,
+            mesh=mesh,
+            in_specs=(
+                tuple(P(RANK_AXIS) for _ in Frontier._fields),
+                P(RANK_AXIS),
+                P(RANK_AXIS),
+                P(None, None),
+                P(None),
+            ),
+            out_specs=(
+                tuple(P(RANK_AXIS) for _ in Frontier._fields),
+                P(RANK_AXIS),
+                P(RANK_AXIS),
+                P(RANK_AXIS),
+                P(RANK_AXIS),
+            ),
+        )
+    )
+
+    t0 = time.perf_counter()
+    t_best = 0.0
+    last_inc = inc_cost0
+    nodes = 0
+    it = 0
+    while it < max_iters:
+        out = step(tuple(fr), ic, itour, d32, min_out)
+        fr = Frontier(*out[0])
+        ic, itour, total, step_nodes = out[1], out[2], out[3], out[4]
+        nodes += int(step_nodes[0])
+        it += inner_steps
+        best = float(ic[0])
+        if best < last_inc:
+            last_inc = best
+            t_best = time.perf_counter() - t0
+        if int(total[0]) == 0:
+            break
+        if time_limit_s is not None and time.perf_counter() - t0 > time_limit_s:
+            break
+    wall = time.perf_counter() - t0
+    overflow = bool(np.asarray(fr.overflow).any())
+    proven = int(total[0]) == 0 and not overflow
+    return BnBResult(
+        cost=float(ic[0]),
+        tour=np.asarray(itour)[0],
+        nodes_expanded=nodes,
+        iterations=it,
+        proven_optimal=proven,
+        wall_seconds=wall,
+        nodes_per_sec=nodes / wall if wall > 0 else 0.0,
+        time_to_best=t_best,
+    )
+
+
+def _norm_ckpt_path(path: str) -> str:
+    # np.savez appends ".npz" when missing; normalize so save/restore agree
+    return path if path.endswith(".npz") else path + ".npz"
+
+
+def _d_fingerprint(d) -> np.ndarray:
+    d = np.asarray(d, np.float64)
+    return np.asarray([d.shape[0], float(d.sum()), float(d.std())])
+
+
+def save(path: str, fr: Frontier, inc_cost, inc_tour, d=None) -> None:
+    """Checkpoint frontier + incumbent (+ instance fingerprint) to ``.npz``."""
+    payload = {
+        "inc_cost": np.asarray(inc_cost),
+        "inc_tour": np.asarray(inc_tour),
+        **{f: np.asarray(getattr(fr, f)) for f in Frontier._fields},
+    }
+    if d is not None:
+        payload["d_fingerprint"] = _d_fingerprint(d)
+    np.savez_compressed(_norm_ckpt_path(path), **payload)
+
+
+def restore(path: str, expect_d=None) -> Tuple[Frontier, jnp.ndarray, jnp.ndarray]:
+    """Load a checkpoint; refuses one written for a different instance."""
+    z = np.load(_norm_ckpt_path(path))
+    if expect_d is not None and "d_fingerprint" in z:
+        if not np.allclose(z["d_fingerprint"], _d_fingerprint(expect_d)):
+            raise ValueError(
+                f"checkpoint {path!r} was written for a different instance "
+                "(distance-matrix fingerprint mismatch)"
+            )
+    fr = Frontier(*(jnp.asarray(z[f]) for f in Frontier._fields))
+    return fr, jnp.asarray(z["inc_cost"]), jnp.asarray(z["inc_tour"])
