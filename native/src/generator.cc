@@ -1,0 +1,67 @@
+/* Blocked-instance generator, bit-exact vs the reference's semantics.
+ *
+ * Replicated behavior (quirks intentional; see SURVEY.md §5 and
+ * ops/generator.py, the Python twin of this file):
+ *  - getBlocksPerDim (tsp.cpp:136-157): perfect square -> sqrt x sqrt,
+ *    else smallest divisor >= 2 times cofactor.
+ *  - distributeCities (tsp.cpp:373-403): block i of rows x cols has
+ *    row = i / rows and col = cols - (i % cols) - 1; each city draws x
+ *    then y through fRand (assignment2.h:86-91).
+ *  - float32 spacing quirk (tsp.cpp:378-379): the per-block spacing and
+ *    the row/col products are C `float`; only the final fRand mix runs in
+ *    double. Reproduced with explicit float casts.
+ *  - grid-spill quirk (SURVEY.md quirk #3): non-square factorizations
+ *    scale `row` (which ranges up to cols-1) by gridDimX/rows, placing
+ *    cities outside the nominal grid. Reproduced faithfully.
+ */
+#include <cmath>
+
+#include "tsp_native.h"
+
+void tsp_blocks_per_dim(int32_t num_blocks, int32_t* rows, int32_t* cols) {
+  if (num_blocks < 1) { /* divisor scan below never terminates for <= 0 */
+    *rows = *cols = 0;
+    return;
+  }
+  double s = std::sqrt((double)num_blocks);
+  if (s - std::floor(s) == 0.0) { /* ISSQUARE, assignment2.h:11 */
+    *rows = *cols = (int32_t)s;
+    return;
+  }
+  int32_t d = 2;
+  while (num_blocks % d != 0) d++;
+  *rows = d;
+  *cols = num_blocks / d;
+}
+
+static inline double frand01(tsp_rand_t* g) {
+  return (double)tsp_rand_next(g) / (double)2147483647;
+}
+
+int32_t tsp_generate(int32_t n, int32_t num_blocks, int32_t grid_dim_x,
+                     int32_t grid_dim_y, uint32_t seed, double* xy) {
+  if (n < 1 || num_blocks < 1 || !xy) return 1;
+  int32_t rows, cols;
+  tsp_blocks_per_dim(num_blocks, &rows, &cols);
+
+  float xspb = (float)grid_dim_x / (float)rows;
+  float yspb = (float)grid_dim_y / (float)cols;
+
+  tsp_rand_t g;
+  tsp_srand(&g, seed);
+  for (int32_t i = 0; i < num_blocks; i++) {
+    int32_t row = i / rows;              /* tsp.cpp:391 */
+    int32_t col = cols - (i % cols) - 1; /* tsp.cpp:393 */
+    double x_lo = (double)((float)row * xspb);
+    double x_hi = (double)((float)(row + 1) * xspb);
+    double y_lo = (double)((float)col * yspb);
+    double y_hi = (double)((float)(col + 1) * yspb);
+    for (int32_t j = 0; j < n; j++) {
+      double fx = frand01(&g); /* x before y, city-minor (tsp.cpp:394-395) */
+      double fy = frand01(&g);
+      xy[((int64_t)i * n + j) * 2 + 0] = x_lo + fx * (x_hi - x_lo);
+      xy[((int64_t)i * n + j) * 2 + 1] = y_lo + fy * (y_hi - y_lo);
+    }
+  }
+  return 0;
+}

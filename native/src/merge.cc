@@ -1,0 +1,64 @@
+/* Tour-merge operator: minimal 2-opt edge swap between two closed tours.
+ *
+ * Same replicated semantics as the JAX twin (ops/merge.py, verified
+ * bit-exact vs goldens), without the reference's O(n1*n2) vector-rotate
+ * scan (tsp.cpp:212-227) — edges are addressed by index instead:
+ *  - all len1 x len2 edge pairs are scored with swapPairCost
+ *    (tsp.cpp:197-200) in its left-to-right addition order;
+ *  - the first minimum in i-major, j-minor order wins (strict <);
+ *  - tour 2 is spliced REVERSED after the first city of tour 1 whose id
+ *    matches either endpoint of the chosen left edge (tsp.cpp:244-259),
+ *    rotated so the chosen right-edge head lands at the boundary;
+ *  - the merged cost is formulaic — cost1 + cost2 + best_swap — and the
+ *    spliced path is never re-measured (SURVEY.md quirk #4).
+ */
+#include <cmath>
+
+#include "tsp_native.h"
+
+static inline double dist2(const double* xy, int32_t a, int32_t b) {
+  double dx = xy[2 * a] - xy[2 * b];
+  double dy = xy[2 * a + 1] - xy[2 * b + 1];
+  return std::sqrt(dx * dx + dy * dy);
+}
+
+double tsp_merge_tours(const double* xy, const int32_t* ids1, int32_t len1,
+                       double cost1, const int32_t* ids2, int32_t len2,
+                       double cost2, int32_t* out, int32_t* out_len) {
+  const double inf = 1.0 / 0.0;
+  double best = inf;
+  int32_t bi = 0, bj = 0;
+  for (int32_t i = 0; i < len1; i++) {
+    int32_t a = ids1[i];
+    int32_t b = ids1[(i + 1 >= len1) ? 0 : i + 1];
+    double d_ab = dist2(xy, a, b);
+    for (int32_t j = 0; j < len2; j++) {
+      int32_t r1 = ids2[j];
+      int32_t r2 = ids2[(j + 1 >= len2) ? 0 : j + 1];
+      /* swapPairCost order: ((d(a,r2) + d(b,r1)) - d(a,b)) - d(r1,r2) */
+      double sc =
+          ((dist2(xy, a, r2) + dist2(xy, b, r1)) - d_ab) - dist2(xy, r1, r2);
+      if (sc < best) {
+        best = sc;
+        bi = i;
+        bj = j;
+      }
+    }
+  }
+
+  const int32_t l2p = len2 - 1; /* tour 2 with the closing duplicate popped */
+  const int32_t p2rot = (bj >= l2p) ? 0 : bj;
+  const int32_t a_id = ids1[bi];
+  const int32_t b_id = ids1[(bi + 1 >= len1) ? 0 : bi + 1];
+
+  int32_t q = 0; /* first position matching either chosen-edge endpoint */
+  while (q < len1 && ids1[q] != a_id && ids1[q] != b_id) q++;
+
+  int32_t pos = 0;
+  for (int32_t t = 0; t <= q; t++) out[pos++] = ids1[t];
+  for (int32_t u = 0; u < l2p; u++)
+    out[pos++] = ids2[((p2rot - u) % l2p + l2p) % l2p];
+  for (int32_t t = q + 1; t < len1; t++) out[pos++] = ids1[t];
+  *out_len = len1 + l2p;
+  return (cost1 + cost2) + best;
+}

@@ -1,0 +1,101 @@
+/* End-to-end blocked pipeline with rank emulation and tree reduction.
+ *
+ * Runs what an MPI launch of the reference computes — generate, scatter by
+ * the round-robin countdown (tsp.cpp:167-191), solve each block exactly,
+ * fold per rank (tsp.cpp:348-352), binary-tree reduce with the reference's
+ * shape: a downshift phase for non-power-of-two rank counts then log2
+ * rounds with receiver k, sender k + 2^d (tsp.cpp:52-134) — in one process
+ * with virtual ranks, the native analog of the single-rank-stub trick
+ * (SURVEY.md §4) generalized to any rank count. Matches the JAX
+ * rank-emulated path (models/distributed.py) bit for bit.
+ *
+ * Deviation (shared with the JAX path): the reference's receive buffer is
+ * never cleared between tree rounds, corrupting second receives
+ * (SURVEY.md quirk #5); here each merge sees its true operands.
+ */
+#include <cstddef>
+#include <cstdint>
+#include <vector>
+
+#include "tsp_native.h"
+
+namespace {
+
+struct Tour {
+  std::vector<int32_t> ids; /* closed tour of global city ids */
+  double cost = 0.0;
+  bool empty() const { return ids.empty(); }
+};
+
+Tour merge(const double* xy, const Tour& t1, const Tour& t2) {
+  if (t2.empty()) return t1; /* idle-rank operand: keep mine */
+  if (t1.empty()) return t2;
+  Tour out;
+  out.ids.resize(t1.ids.size() + t2.ids.size() - 1);
+  int32_t out_len = 0;
+  out.cost = tsp_merge_tours(xy, t1.ids.data(), (int32_t)t1.ids.size(),
+                             t1.cost, t2.ids.data(), (int32_t)t2.ids.size(),
+                             t2.cost, out.ids.data(), &out_len);
+  out.ids.resize(out_len);
+  return out;
+}
+
+} /* namespace */
+
+int32_t tsp_run_pipeline(int32_t n, int32_t num_blocks, int32_t grid_dim_x,
+                         int32_t grid_dim_y, uint32_t seed, int32_t ranks,
+                         double* cost_out, int32_t* tour_out,
+                         int32_t* tour_len_out, double* block_costs_out) {
+  if (n < 3 || n > 20 || num_blocks < 1 || ranks < 1) return 1;
+
+  std::vector<double> xy((int64_t)num_blocks * n * 2);
+  if (tsp_generate(n, num_blocks, grid_dim_x, grid_dim_y, seed, xy.data()))
+    return 1;
+
+  /* solve every block exactly; tours carry global city ids */
+  std::vector<Tour> blocks(num_blocks);
+  std::vector<double> dist((int64_t)n * n);
+  std::vector<int32_t> local(n + 1);
+  for (int32_t b = 0; b < num_blocks; b++) {
+    tsp_distance_matrix(n, xy.data() + (int64_t)b * n * 2, dist.data());
+    double c = tsp_solve_block(n, dist.data(), local.data());
+    if (c < 0) return 1;
+    blocks[b].cost = c;
+    blocks[b].ids.resize(n + 1);
+    for (int32_t j = 0; j <= n; j++) blocks[b].ids[j] = local[j] + b * n;
+    if (block_costs_out) block_costs_out[b] = c;
+  }
+
+  /* reference block assignment: counts[r] = #{b in 1..B : b mod P == r},
+   * blocks handed out contiguously in rank order (tsp.cpp:167-191) */
+  std::vector<int32_t> counts(ranks, 0);
+  for (int32_t b = 1; b <= num_blocks; b++) counts[b % ranks]++;
+
+  /* per-rank local fold (tsp.cpp:348-352) */
+  std::vector<Tour> per_rank(ranks);
+  int32_t start = 0;
+  for (int32_t r = 0; r < ranks; r++) {
+    Tour acc; /* empty when this rank got zero blocks */
+    for (int32_t k = 0; k < counts[r]; k++)
+      acc = merge(xy.data(), acc, blocks[start + k]);
+    per_rank[r] = acc;
+    start += counts[r];
+  }
+
+  /* tree reduction, reference shape (tsp.cpp:52-134) */
+  int32_t lastpower = 1;
+  while (lastpower * 2 <= ranks) lastpower *= 2;
+  for (int32_t r = lastpower; r < ranks; r++) /* downshift phase */
+    per_rank[r - lastpower] = merge(xy.data(), per_rank[r - lastpower], per_rank[r]);
+  for (int32_t stride = 1; stride < lastpower; stride *= 2)
+    for (int32_t k = 0; k < lastpower; k += 2 * stride)
+      per_rank[k] = merge(xy.data(), per_rank[k], per_rank[k + stride]);
+
+  const Tour& final_tour = per_rank[0];
+  if (cost_out) *cost_out = final_tour.cost;
+  if (tour_len_out) *tour_len_out = (int32_t)final_tour.ids.size();
+  if (tour_out)
+    for (std::size_t j = 0; j < final_tour.ids.size(); j++)
+      tour_out[j] = final_tour.ids[j];
+  return 0;
+}

@@ -1,0 +1,47 @@
+/* Bit-exact replica of glibc's default rand() (TYPE_3 additive feedback).
+ *
+ * The reference's whole instance is a deterministic function of srand(0)
+ * plus a strictly ordered rand() sequence (tsp.cpp:273, assignment2.h:86-91),
+ * so this replica is the determinism root shared by the native pipeline and
+ * the Python generator (ops/rand.py implements the identical algorithm; the
+ * two are cross-checked in tests/test_native.py).
+ *
+ * Algorithm (public, documented in glibc stdlib/random_r.c): a 31-word
+ * additive-feedback generator with taps at lags 3 and 31, Lehmer-seeded,
+ * first 310 outputs discarded, each output is the new word >> 1.
+ */
+#include "tsp_native.h"
+
+void tsp_srand(tsp_rand_t* g, uint32_t seed) {
+  if (seed == 0) seed = 1;
+  uint32_t r[344];
+  r[0] = seed;
+  /* Lehmer seeding runs on int32 words with C truncating division. */
+  int64_t word = (int32_t)seed;
+  for (int i = 1; i < 31; i++) {
+    int64_t hi = word / 127773;
+    int64_t lo = word % 127773;
+    word = 16807 * lo - 2836 * hi;
+    if (word < 0) word += 2147483647;
+    r[i] = (uint32_t)word;
+  }
+  for (int i = 31; i < 34; i++) r[i] = r[i - 31];
+  for (int i = 34; i < 344; i++) r[i] = r[i - 31] + r[i - 3]; /* mod 2^32 */
+  /* keep the last 31 words; r[313] is the oldest (lag-31 tap of output 0) */
+  for (int i = 0; i < 31; i++) g->window[i] = r[313 + i];
+  g->pos = 0;
+}
+
+int32_t tsp_rand_next(tsp_rand_t* g) {
+  int p = g->pos;
+  uint32_t val = g->window[p] + g->window[(p + 28) % 31]; /* lags 31 and 3 */
+  g->window[p] = val; /* oldest slot becomes the newest word */
+  g->pos = (p + 1) % 31;
+  return (int32_t)(val >> 1);
+}
+
+void tsp_rand_stream(uint32_t seed, int64_t count, int32_t* out) {
+  tsp_rand_t g;
+  tsp_srand(&g, seed);
+  for (int64_t i = 0; i < count; i++) out[i] = tsp_rand_next(&g);
+}

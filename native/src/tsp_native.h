@@ -1,0 +1,98 @@
+/* tsp_native — native C++ runtime for the TPU-TSP framework.
+ *
+ * This is the framework's host-side native layer (the analog of the
+ * reference's C++/MPI runtime, tsp.cpp + assignment2.h): a bit-exact
+ * instance generator (glibc-rand replica), a dense array-based Held-Karp
+ * solver, the 2-opt tour-merge operator, and the full rank-emulated
+ * pipeline with the reference's binary-tree reduction shape
+ * (tsp.cpp:52-134). It serves as
+ *
+ *  - the self-contained CPU oracle (goldens can be regenerated and parity
+ *    checked without the upstream sources present), and
+ *  - the fast host path behind the CLI's --backend=native.
+ *
+ * Design is clean-room and array-first: the DP table is a dense
+ * [2^(n-1), n-1] array indexed by (visited-mask, endpoint) — the same
+ * layout as the JAX kernel (ops/held_karp.py) — not the reference's
+ * std::map of composite keys (tsp.cpp:409). All floating-point runs in
+ * strict double with contraction disabled so results are bit-identical to
+ * the Python/numpy path and to a glibc build of the reference.
+ */
+#ifndef TSP_NATIVE_H
+#define TSP_NATIVE_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* ---- glibc TYPE_3 rand() replica (determinism root; tsp.cpp:273) ---- */
+
+typedef struct {
+  uint32_t window[31]; /* ring of the last 31 words */
+  int pos;             /* index of the oldest word (lag 31) */
+} tsp_rand_t;
+
+void tsp_srand(tsp_rand_t* g, uint32_t seed);
+int32_t tsp_rand_next(tsp_rand_t* g);
+/* Fill out[0..count) with successive rand() outputs from `seed`. */
+void tsp_rand_stream(uint32_t seed, int64_t count, int32_t* out);
+
+/* ---- instance generation (tsp.cpp:136-157, 373-403 semantics) ---- */
+
+/* Near-square factorization; writes rows/cols. */
+void tsp_blocks_per_dim(int32_t num_blocks, int32_t* rows, int32_t* cols);
+
+/* Generate num_blocks blocks of n cities each into xy[b*n*2 + j*2 + {0,1}]
+ * (block-major, city-minor, x then y — generation order == rand order).
+ * Returns 0 on success, nonzero on bad arguments. */
+int32_t tsp_generate(int32_t num_cities_per_block, int32_t num_blocks,
+                     int32_t grid_dim_x, int32_t grid_dim_y, uint32_t seed,
+                     double* xy);
+
+/* ---- exact per-block solver (dense Held-Karp) ---- */
+
+/* Exact TSP over one block given its dense [n, n] distance matrix.
+ * Writes the closed tour (block-local indices, tour[0]==tour[n]==0) into
+ * tour[0..n]. Returns the optimal cost; ties break toward the smallest
+ * predecessor index (matching the JAX kernel and the reference's strict-<
+ * ascending scan). n must be in [3, 20]. Returns -1.0 on bad n. */
+double tsp_solve_block(int32_t n, const double* dist, int32_t* tour);
+
+/* Dense Euclidean distance matrix from xy[n*2] into dist[n*n]. */
+void tsp_distance_matrix(int32_t n, const double* xy, double* dist);
+
+/* ---- tour-merge operator (tsp.cpp:197-269 semantics) ---- */
+
+/* Merge closed tour 2 into closed tour 1 by the minimal 2-opt edge swap.
+ * Distances are computed from global coordinates xy[>=max_id*2].
+ * out must hold len1 + len2 - 1 entries; *out_len receives that length.
+ * Returns the (formulaic) merged cost cost1 + cost2 + best_swap.
+ * Both operands must hold >= 3 distinct cities. */
+double tsp_merge_tours(const double* xy, const int32_t* ids1, int32_t len1,
+                       double cost1, const int32_t* ids2, int32_t len2,
+                       double cost2, int32_t* out, int32_t* out_len);
+
+/* ---- full pipeline (generate -> solve -> fold -> tree reduce) ---- */
+
+/* Run the blocked pipeline end to end, emulating `ranks` MPI ranks with
+ * the reference's block assignment (tsp.cpp:167-191) and binary-tree
+ * reduction shape (tsp.cpp:52-134).
+ *
+ * Outputs (any may be NULL to skip):
+ *   cost_out        final tour cost (rank-0 result)
+ *   tour_out        closed global tour, capacity num_blocks*n + 1
+ *   tour_len_out    number of valid entries in tour_out
+ *   block_costs_out per-block optimal costs, capacity num_blocks
+ * Returns 0 on success; 1 on bad arguments. */
+int32_t tsp_run_pipeline(int32_t num_cities_per_block, int32_t num_blocks,
+                         int32_t grid_dim_x, int32_t grid_dim_y, uint32_t seed,
+                         int32_t ranks, double* cost_out, int32_t* tour_out,
+                         int32_t* tour_len_out, double* block_costs_out);
+
+#ifdef __cplusplus
+} /* extern "C" */
+#endif
+
+#endif /* TSP_NATIVE_H */

@@ -1,0 +1,120 @@
+"""Native C++ runtime: parity vs goldens, the Python generator, and JAX.
+
+The native layer must agree bit-for-bit with (a) the committed oracle
+goldens, (b) the Python/numpy generator twin, and (c) the JAX float64
+pipeline — the three-way check that pins all implementations to the same
+contract.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from tsp_mpi_reduction_tpu import native
+from tsp_mpi_reduction_tpu.ops.distance import distance_matrix_np
+from tsp_mpi_reduction_tpu.ops.generator import generate_instance, get_blocks_per_dim
+from tsp_mpi_reduction_tpu.ops.rand import GlibcRand
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _built():
+    native.build()
+
+
+def test_rand_stream_matches_golden(goldens_dir):
+    golden = json.loads((goldens_dir / "glibc_rand_seed0.json").read_text())
+    got = native.rand_stream(0, len(golden["values"]))
+    assert got.tolist() == golden["values"]
+
+
+def test_rand_stream_matches_python_nonzero_seeds():
+    for seed in (1, 42, 123456789, 2**31 + 7):
+        rng = GlibcRand(seed)
+        assert native.rand_stream(seed, 500).tolist() == rng.fill(500).tolist()
+
+
+def test_blocks_per_dim_matches_python():
+    for nb in list(range(1, 60)) + [97, 100, 144, 200]:
+        assert native.blocks_per_dim(nb) == get_blocks_per_dim(nb)
+
+
+@pytest.mark.parametrize("config", ["10x6_500x500", "13x4_1000x1000"])
+def test_generate_matches_golden(goldens_dir, config):
+    golden = json.loads((goldens_dir / f"full_{config}.json").read_text())
+    c = golden["config"]
+    xy = native.generate(c["ncpb"], c["nblocks"], c["gx"], c["gy"], seed=0)
+    gold = np.asarray(
+        [[[city[1], city[2]] for city in block] for block in golden["blocks"]]
+    )
+    np.testing.assert_array_equal(xy, gold)  # bit-exact
+
+
+def test_generate_matches_python_generator():
+    _, xy_py = generate_instance(7, 12, 777, 333, seed=5)
+    xy_c = native.generate(7, 12, 777, 333, seed=5)
+    np.testing.assert_array_equal(xy_c, xy_py)
+
+
+@pytest.mark.parametrize("config", ["10x6_500x500", "13x4_1000x1000"])
+def test_solve_block_matches_golden(goldens_dir, config):
+    golden = json.loads((goldens_dir / f"full_{config}.json").read_text())
+    c = golden["config"]
+    xy = native.generate(c["ncpb"], c["nblocks"], c["gx"], c["gy"], seed=0)
+    for b, sol in enumerate(golden["block_solutions"]):
+        dist = distance_matrix_np(xy[b])
+        cost, tour = native.solve_block(dist)
+        assert cost == sol["cost"]  # bit-exact double
+        got_global = (tour + b * c["ncpb"]).tolist()
+        assert got_global == sol["ids"]
+
+
+@pytest.mark.parametrize(
+    "config", ["10x6_500x500", "10x10_123x457", "13x4_1000x1000"]
+)
+def test_pipeline_matches_golden(goldens_dir, config):
+    golden = json.loads((goldens_dir / f"full_{config}.json").read_text())
+    c = golden["config"]
+    cost, tour, block_costs = native.run_pipeline(
+        c["ncpb"], c["nblocks"], c["gx"], c["gy"], seed=0, ranks=1
+    )
+    assert cost == golden["final"]["cost"]
+    assert tour.tolist() == golden["final"]["ids"]
+    assert block_costs.tolist() == [s["cost"] for s in golden["block_solutions"]]
+
+
+def test_pipeline_multirank_matches_jax_emulation():
+    from tsp_mpi_reduction_tpu.models.distributed import run_pipeline_ranks
+
+    for ranks in (1, 2, 3, 4, 6):
+        c_cost, c_tour, _ = native.run_pipeline(6, 12, 800, 600, ranks=ranks)
+        j = run_pipeline_ranks(6, 12, 800, 600, ranks, dtype="float64")
+        assert c_cost == j.cost
+        assert c_tour.tolist() == j.tour_ids.tolist()
+
+
+def test_merge_matches_jax_operator():
+    import jax.numpy as jnp
+
+    from tsp_mpi_reduction_tpu.ops.merge import PaddedTour, merge_tours
+
+    xy = native.generate(5, 4, 300, 300)
+    flat = xy.reshape(-1, 2)
+    dist = distance_matrix_np(flat)
+    c1, t1 = native.solve_block(distance_matrix_np(xy[0]))
+    c2, t2 = native.solve_block(distance_matrix_np(xy[1]))
+    t2g = t2 + 5
+    n_cost, n_ids = native.merge_tours(flat, t1, c1, t2g, c2)
+
+    cap = len(t1) + len(t2g) - 1
+    p1 = PaddedTour(
+        jnp.asarray(np.pad(t1, (0, cap - len(t1))), jnp.int32),
+        jnp.asarray(len(t1), jnp.int32),
+        jnp.asarray(c1),
+    )
+    p2 = PaddedTour(
+        jnp.asarray(t2g, jnp.int32), jnp.asarray(len(t2g), jnp.int32), jnp.asarray(c2)
+    )
+    merged = merge_tours(p1, p2, jnp.asarray(dist))
+    assert float(merged.cost) == n_cost
+    assert np.asarray(merged.ids)[: int(merged.length)].tolist() == n_ids.tolist()

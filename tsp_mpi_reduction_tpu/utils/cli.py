@@ -46,12 +46,51 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("numBlocks", type=int)
     p.add_argument("gridDimX", type=int)
     p.add_argument("gridDimY", type=int)
-    p.add_argument("--backend", default="auto", choices=["auto", "cpu", "tpu"])
+    p.add_argument(
+        "--backend", default="auto", choices=["auto", "cpu", "tpu", "native"]
+    )
     p.add_argument("--ranks", type=int, default=1, metavar="P")
     p.add_argument("--dtype", default=None, choices=["float64", "float32"])
     p.add_argument("--metrics", action="store_true")
     p.add_argument("--seed", type=int, default=0)
     return p
+
+
+def _emit_result(
+    args,
+    *,
+    backend: str,
+    dtype: str,
+    cost: float,
+    num_cities: int,
+    t_start: float,
+    phase_seconds=None,
+    dp_states: int = 0,
+    dp_transitions: int = 0,
+) -> None:
+    """Shared epilogue: the machine-parsed final line + optional metrics."""
+    elapsed_ms = int((time.perf_counter() - t_start) * 1000)
+    print(reporting.final_line(elapsed_ms, num_cities, cost))
+    if args.metrics:
+        print(
+            reporting.metrics_json(
+                config={
+                    "numCitiesPerBlock": args.numCitiesPerBlock,
+                    "numBlocks": args.numBlocks,
+                    "gridDimX": args.gridDimX,
+                    "gridDimY": args.gridDimY,
+                    "ranks": args.ranks,
+                    "backend": backend,
+                    "dtype": dtype,
+                },
+                elapsed_ms=elapsed_ms,
+                cost=cost,
+                phase_seconds=phase_seconds,
+                dp_states=dp_states,
+                dp_transitions=dp_transitions,
+            ),
+            file=sys.stderr,
+        )
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -70,6 +109,34 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.numCitiesPerBlock > 16:
         print(reporting.too_many_cities_line())
         sys.exit(1337)  # truncated by the OS to 57, as the reference's is
+
+    n, nb = args.numCitiesPerBlock, args.numBlocks
+    if args.backend == "native":
+        # pure C++ host path (native/): no jax import, double precision only
+        if args.dtype == "float32":
+            print(
+                "error: --backend=native runs float64 only (drop --dtype)",
+                file=sys.stderr,
+            )
+            return 2
+        from .. import native
+
+        print(reporting.banner_line(n, nb))
+        rows, cols = native.blocks_per_dim(nb)
+        print(reporting.dims_line(rows, cols))
+        try:
+            cost, tour, _ = native.run_pipeline(
+                n, nb, args.gridDimX, args.gridDimY, seed=args.seed,
+                ranks=args.ranks,
+            )
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        _emit_result(
+            args, backend="native", dtype="float64", cost=cost,
+            num_cities=nb * n, t_start=t_start,
+        )
+        return 0
 
     platform = select_backend(args.backend)
     dtype = args.dtype or ("float64" if platform == "cpu" else "float32")
@@ -95,7 +162,6 @@ def main(argv: Optional[List[str]] = None) -> int:
     from ..models.pipeline import run_pipeline
     from ..ops.generator import get_blocks_per_dim
 
-    n, nb = args.numCitiesPerBlock, args.numBlocks
     print(reporting.banner_line(n, nb))
     rows, cols = get_blocks_per_dim(nb)
     print(reporting.dims_line(rows, cols))
@@ -114,26 +180,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"error: {e}", file=sys.stderr)
         return 2
 
-    elapsed_ms = int((time.perf_counter() - t_start) * 1000)
-    print(reporting.final_line(elapsed_ms, res.num_cities, res.cost))
-    if args.metrics:
-        print(
-            reporting.metrics_json(
-                config={
-                    "numCitiesPerBlock": n,
-                    "numBlocks": nb,
-                    "gridDimX": args.gridDimX,
-                    "gridDimY": args.gridDimY,
-                    "ranks": args.ranks,
-                    "backend": platform,
-                    "dtype": dtype,
-                },
-                elapsed_ms=elapsed_ms,
-                cost=res.cost,
-                phase_seconds=res.phase_seconds,
-                dp_states=res.dp_states,
-                dp_transitions=res.dp_transitions,
-            ),
-            file=sys.stderr,
-        )
+    _emit_result(
+        args, backend=platform, dtype=dtype, cost=res.cost,
+        num_cities=res.num_cities, t_start=t_start,
+        phase_seconds=res.phase_seconds, dp_states=res.dp_states,
+        dp_transitions=res.dp_transitions,
+    )
     return 0
