@@ -1,0 +1,110 @@
+"""Pallas relaxation kernel: bit-parity vs the jnp path (interpret on CPU)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from tsp_mpi_reduction_tpu.ops import held_karp
+from tsp_mpi_reduction_tpu.ops.held_karp_pallas import relax_minplus, relax_reference
+
+
+@pytest.mark.parametrize("m", [4, 9, 15, 17])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.float64])
+def test_relax_matches_reference(m, dtype):
+    rng = np.random.default_rng(m)
+    j = 130  # not a multiple of the row tile: exercises padding
+    g = rng.uniform(0, 100, (j, m)).astype(dtype)
+    g[rng.uniform(size=(j, m)) < 0.2] = np.inf  # masked-out predecessors
+    g[3] = np.inf  # an all-inf row (no valid predecessor): stays inf, parent 0
+    d_t = rng.uniform(0, 50, (m, m)).astype(dtype)
+
+    ref_c, ref_p = relax_reference(jnp.asarray(g), jnp.asarray(d_t))
+    got_c, got_p = relax_minplus(jnp.asarray(g), jnp.asarray(d_t), interpret=True)
+    np.testing.assert_array_equal(np.asarray(got_c), np.asarray(ref_c))
+    np.testing.assert_array_equal(np.asarray(got_p), np.asarray(ref_p))
+
+
+@pytest.mark.parametrize("n", [6, 10])
+def test_full_solve_pallas_matches_jnp(n):
+    """End-to-end DP with the kernel == the jnp path, bit for bit."""
+    rng = np.random.default_rng(0)
+    xy = rng.uniform(0, 500, (4, n, 2))
+    from tsp_mpi_reduction_tpu.ops.distance import distance_matrix_np
+
+    d = jnp.asarray(distance_matrix_np(xy))
+    held_karp.set_impl("jnp")
+    try:
+        c_ref, t_ref = held_karp.solve_blocks_from_dists(d, jnp.float64)
+        held_karp.set_impl("pallas")
+        c_got, t_got = held_karp.solve_blocks_from_dists(d, jnp.float64)
+    finally:
+        held_karp.set_impl("auto")
+    np.testing.assert_array_equal(np.asarray(c_got), np.asarray(c_ref))
+    np.testing.assert_array_equal(np.asarray(t_got), np.asarray(t_ref))
+
+
+def test_auto_policy_is_compact():
+    assert held_karp._effective_impl(jnp.float64) == "compact"
+    assert held_karp._effective_impl(jnp.float32) == "compact"
+
+
+@pytest.mark.parametrize("n", [5, 10, 13])
+def test_fused_pallas_matches_compact(n):
+    """Fused dense kernel + parent-free backtrack == compact, bit for bit."""
+    rng = np.random.default_rng(n)
+    xy = rng.uniform(0, 500, (3, n, 2))
+    from tsp_mpi_reduction_tpu.ops.distance import distance_matrix_np
+
+    d = jnp.asarray(distance_matrix_np(xy))
+    held_karp.set_impl("compact")
+    try:
+        c_ref, t_ref = held_karp.solve_blocks_from_dists(d, jnp.float64)
+        held_karp.set_impl("fused")
+        c_got, t_got = held_karp.solve_blocks_from_dists(d, jnp.float64)
+    finally:
+        held_karp.set_impl("auto")
+    np.testing.assert_array_equal(np.asarray(c_got), np.asarray(c_ref))
+    np.testing.assert_array_equal(np.asarray(t_got), np.asarray(t_ref))
+
+
+@pytest.mark.parametrize("n", [5, 8, 12])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.float64])
+def test_dense_sweep_matches_compact(n, dtype):
+    """The dense bit-swap formulation is bit-identical to the compacted DP."""
+    rng = np.random.default_rng(n)
+    xy = rng.uniform(0, 500, (5, n, 2))
+    from tsp_mpi_reduction_tpu.ops.distance import distance_matrix_np
+
+    d = jnp.asarray(distance_matrix_np(xy), dtype)
+    held_karp.set_impl("compact")
+    try:
+        c_ref, t_ref = held_karp.solve_blocks_from_dists(d, dtype)
+        held_karp.set_impl("dense")
+        c_got, t_got = held_karp.solve_blocks_from_dists(d, dtype)
+    finally:
+        held_karp.set_impl("auto")
+    np.testing.assert_array_equal(np.asarray(c_got), np.asarray(c_ref))
+    np.testing.assert_array_equal(np.asarray(t_got), np.asarray(t_ref))
+
+
+def test_dense_sweep_matches_golden_solutions(goldens_dir):
+    """Dense impl reproduces oracle block solutions bit-for-bit (f64)."""
+    import json
+
+    golden = json.loads((goldens_dir / "full_10x6_500x500.json").read_text())
+    from tsp_mpi_reduction_tpu.ops.distance import distance_matrix_np
+
+    xy = np.asarray(
+        [[[c[1], c[2]] for c in blk] for blk in golden["blocks"]]
+    )
+    d = jnp.asarray(distance_matrix_np(xy))
+    held_karp.set_impl("dense")
+    try:
+        costs, tours = held_karp.solve_blocks_from_dists(d, jnp.float64)
+    finally:
+        held_karp.set_impl("auto")
+    n = xy.shape[1]
+    for b, sol in enumerate(golden["block_solutions"]):
+        assert float(costs[b]) == sol["cost"]
+        assert (np.asarray(tours[b]) + b * n).tolist() == sol["ids"]

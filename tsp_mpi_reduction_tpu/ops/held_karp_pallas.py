@@ -1,0 +1,185 @@
+"""Pallas TPU kernel for the Held-Karp min-plus relaxation (the hot op).
+
+Each cardinality step of the dense DP (ops/held_karp.py, the TPU redesign
+of the reference's map-based loops, tsp.cpp:442-481) spends its cycles in
+
+    cand[j, k, m'] = g[j, m'] + d_t[k, m']
+    new_cost[j, k]   = min_{m'} cand[j, k, m']
+    new_parent[j, k] = argmin_{m'} cand[j, k, m']
+
+a min-plus "matmul" between the gathered predecessor costs ``g`` and the
+transposed distance block ``d_t``. This module implements that contraction
+as a Pallas kernel: ``g`` tiles stream HBM->VMEM once and both reductions
+(min and argmin) happen in registers per tile, instead of materializing the
+``[J, K, M]`` candidate tensor. Lanes are padded to 128 with +inf, which is
+absorbed by the min; rows whose mask excludes every predecessor stay +inf
+and keep argmin==0 — exactly the jnp path's semantics, so the kernel is a
+drop-in replacement validated bit-for-bit in tests (interpret mode on CPU,
+compiled on TPU).
+
+Both kernels here are OPT-IN via ``held_karp.set_impl("pallas"|"fused")``:
+the ``auto`` policy always resolves to the compacted jnp path, which
+measured fastest on a v5e (see the impl table in held_karp.py). They are
+kept as the framework's kernel path, bit-exact-tested against the default.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128  # TPU lane width; m <= 17 always fits one lane tile
+_ROW_TILE = 256
+
+
+def _relax_kernel(g_ref, dt_ref, cost_ref, parent_ref, *, m: int):
+    """One row-tile: min-plus contract ``g`` with every d_t row.
+
+    g_ref:    [TJ, 128] gathered predecessor costs (+inf beyond column m)
+    dt_ref:   [R8, 128] d_t rows (R8 = m padded to sublanes; +inf padding)
+    cost_ref / parent_ref: [TJ, 128] outputs (columns >= m are scratch)
+    """
+    g = g_ref[:]
+    for k in range(m):  # static unroll: m-1 <= 16 iterations
+        cand = g + dt_ref[k, :][None, :]
+        cost_ref[:, k] = jnp.min(cand, axis=1)
+        parent_ref[:, k] = jnp.argmin(cand, axis=1).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def relax_minplus(
+    g: jnp.ndarray, d_t: jnp.ndarray, interpret: bool = False
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Min-plus relaxation for one step: ``g`` [J, M] x ``d_t`` [M, M].
+
+    Returns (new_cost [J, M], new_parent [J, M] int32) where column k holds
+    ``min/argmin over m' of g[j, m'] + d_t[k, m']``. Ties break to the
+    first (smallest) m', matching ``jnp.argmin`` in the reference path.
+    """
+    j, m = g.shape
+    dtype = g.dtype
+    inf = jnp.asarray(jnp.inf, dtype)
+
+    jp = -(-j // _ROW_TILE) * _ROW_TILE
+    rows8 = max(8, -(-m // 8) * 8)
+    g_pad = jnp.full((jp, LANES), inf, dtype).at[:j, :m].set(g)
+    dt_pad = jnp.full((rows8, LANES), inf, dtype).at[:m, :m].set(d_t)
+
+    cost, parent = pl.pallas_call(
+        functools.partial(_relax_kernel, m=m),
+        grid=(jp // _ROW_TILE,),
+        in_specs=[
+            pl.BlockSpec((_ROW_TILE, LANES), lambda i: (i, 0)),
+            pl.BlockSpec((rows8, LANES), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((_ROW_TILE, LANES), lambda i: (i, 0)),
+            pl.BlockSpec((_ROW_TILE, LANES), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((jp, LANES), dtype),
+            jax.ShapeDtypeStruct((jp, LANES), jnp.int32),
+        ],
+        interpret=interpret,
+    )(g_pad, dt_pad)
+    return cost[:j, :m], parent[:j, :m]
+
+
+def relax_reference(g: jnp.ndarray, d_t: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """The jnp formulation the kernel replaces (for parity tests)."""
+    cand = g[:, None, :] + d_t[None, :, :]
+    return jnp.min(cand, axis=-1), jnp.argmin(cand, axis=-1).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# Dense-sweep relaxation: the flagship kernel of the f32 speed path.
+#
+# Layout [m, 2^m] with the mask axis on lanes (held_karp._solve_one_dense).
+# The kernel receives the current table and the bit-swapped predecessor
+# table G (G[b, mask] = cost[b, mask ^ (1<<b)], prepared by XLA as 15
+# reshape+flips), keeps both tile-resident in VMEM, and produces ALL m
+# output rows per tile read — where the XLA fusion re-reads G for every
+# endpoint k. Membership bits and popcounts are derived in-register from a
+# lane-index iota (no bit tables in memory), and no parent/argmin work
+# happens in the hot loop at all: parents are recomputed exactly during
+# backtracking (held_karp._backtrack_recompute) from the finished table.
+# ---------------------------------------------------------------------------
+
+_DENSE_TILE = 2048  # lanes per tile: [16, 2048] f32 x 3 buffers = 384 KB VMEM
+
+
+def _relax_dense_kernel(
+    c_ref, cost_ref, g_ref, dsub_ref, out_ref, *, m: int, rows: int, tile: int
+):
+    """One [rows, tile] tile of the dense relaxation at cardinality ``c``.
+
+    c_ref:    [1] int32 in SMEM — current subset cardinality
+    cost_ref: [rows, tile] current DP table (rows >= m are padding)
+    g_ref:    [rows, tile] bit-swapped predecessor costs (+inf padded rows)
+    dsub_ref: [rows, rows] distance block, d_sub[b, k] (+inf padded rows)
+    out_ref:  [rows, tile] updated table
+    """
+    j = pl.program_id(0)
+    c = c_ref[0]
+    inf = jnp.asarray(jnp.inf, cost_ref.dtype)
+
+    # mask value per lane, bit index per sublane — both from iota, no memory
+    # (int32 arithmetic throughout: Mosaic rejects some bool-vector casts)
+    mask2d = jax.lax.broadcasted_iota(jnp.int32, (rows, tile), 1) + j * tile
+    b2d = jax.lax.broadcasted_iota(jnp.int32, (rows, tile), 0)
+    bits_i = jax.lax.shift_right_logical(mask2d, b2d) & 1  # int32 0/1
+    in_range = b2d < m
+    g = jnp.where((bits_i == 1) & in_range, g_ref[:], inf)  # b in mask
+    popc = jnp.sum(jnp.where(in_range, bits_i, 0), axis=0)
+
+    cost = cost_ref[:]
+    mask_row = mask2d[0]
+    upd_c = popc == c  # [tile] masks of this cardinality
+    for k in range(m):  # static unroll, <= 17 rows
+        cand = g + dsub_ref[:, k][:, None]
+        mn = jnp.min(cand, axis=0)  # [tile]
+        upd = upd_c & (((mask_row >> k) & 1) == 0)  # endpoint k outside mask
+        out_ref[k, :] = jnp.where(upd, mn, cost[k, :])
+    for k in range(m, rows):  # padding rows pass through
+        out_ref[k, :] = cost[k, :]
+
+
+@functools.partial(jax.jit, static_argnames=("m", "interpret"))
+def relax_dense(
+    cost: jnp.ndarray,
+    g: jnp.ndarray,
+    d_sub: jnp.ndarray,
+    c: jnp.ndarray,
+    m: int,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """One dense DP step: update all popcount-``c`` states of ``cost``.
+
+    Args:
+      cost: [16, S] padded table (rows >= m ignored/passed through).
+      g:    [16, S] bit-swapped predecessor table (rows >= m must be +inf).
+      d_sub: [16, 16] padded distance block, d_sub[b, k] = d(b+1, k+1).
+      c: scalar int32 cardinality of this step.
+      m: number of non-anchor cities (n - 1).
+    """
+    rows, s = cost.shape
+    tile = min(_DENSE_TILE, s)
+    return pl.pallas_call(
+        functools.partial(_relax_dense_kernel, m=m, rows=rows, tile=tile),
+        grid=(s // tile,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((rows, tile), lambda i: (0, i)),
+            pl.BlockSpec((rows, tile), lambda i: (0, i)),
+            pl.BlockSpec((rows, rows), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((rows, tile), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((rows, s), cost.dtype),
+        interpret=interpret,
+    )(c.reshape(1).astype(jnp.int32), cost, g, d_sub)
