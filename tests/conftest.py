@@ -8,29 +8,12 @@ oracle parity is checked bit-for-bit against the C++ double-precision
 reference (the TPU speed path, by contrast, runs float32).
 """
 
-import os
+import jax
 
-# NOTE: in this image, sitecustomize imports jax at interpreter startup and
-# registers the remote-TPU ("axon") backend, with JAX_PLATFORMS=axon already
-# in the environment. Env edits here are therefore too late — jax read the
-# env at its (startup) import. Force the platform through jax.config and
-# deregister the axon factory so tests can never touch (or hang on) the
-# remote-TPU tunnel.
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+from tsp_mpi_reduction_tpu.utils.backend import force_host_platform
 
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
+force_host_platform(8)
 jax.config.update("jax_enable_x64", True)
-
-from jax._src import xla_bridge as _xb  # noqa: E402
-
-_xb._backend_factories.pop("axon", None)
 
 import pathlib  # noqa: E402
 

@@ -63,3 +63,21 @@ def test_metrics_flag_emits_json(capsys):
     m = json.loads(err.strip().split("\n")[-1])
     assert m["config"]["numBlocks"] == 10
     assert m["cost"] > 0
+
+
+def test_select_backend_tpu_detects_initialized_cpu_backend():
+    """A cached CPU backend must not masquerade as a TPU (phantom-accelerator
+    guard in select_backend's probe loop)."""
+    import jax
+    import jax.numpy as jnp
+    import pytest
+
+    from tsp_mpi_reduction_tpu.utils import backend
+
+    _ = jnp.zeros(1) + 1  # ensure the (conftest-pinned) CPU backend is live
+    if "tpu" not in backend._registered_platforms():
+        pytest.skip("no tpu factory registered")
+    prev = jax.config.jax_platforms
+    with pytest.raises(RuntimeError, match="no accelerator platform"):
+        backend.select_backend("tpu")
+    assert jax.config.jax_platforms == prev  # config restored on failure

@@ -33,13 +33,10 @@ def test_full_solve_pallas_matches_jnp(n):
     from tsp_mpi_reduction_tpu.ops.distance import distance_matrix_np
 
     d = jnp.asarray(distance_matrix_np(xy))
-    held_karp.set_impl("jnp")
-    try:
+    with held_karp.use_impl("jnp"):
         c_ref, t_ref = held_karp.solve_blocks_from_dists(d, jnp.float64)
-        held_karp.set_impl("pallas")
+    with held_karp.use_impl("pallas"):
         c_got, t_got = held_karp.solve_blocks_from_dists(d, jnp.float64)
-    finally:
-        held_karp.set_impl("auto")
     np.testing.assert_array_equal(np.asarray(c_got), np.asarray(c_ref))
     np.testing.assert_array_equal(np.asarray(t_got), np.asarray(t_ref))
 
@@ -57,13 +54,10 @@ def test_fused_pallas_matches_compact(n):
     from tsp_mpi_reduction_tpu.ops.distance import distance_matrix_np
 
     d = jnp.asarray(distance_matrix_np(xy))
-    held_karp.set_impl("compact")
-    try:
+    with held_karp.use_impl("compact"):
         c_ref, t_ref = held_karp.solve_blocks_from_dists(d, jnp.float64)
-        held_karp.set_impl("fused")
+    with held_karp.use_impl("fused"):
         c_got, t_got = held_karp.solve_blocks_from_dists(d, jnp.float64)
-    finally:
-        held_karp.set_impl("auto")
     np.testing.assert_array_equal(np.asarray(c_got), np.asarray(c_ref))
     np.testing.assert_array_equal(np.asarray(t_got), np.asarray(t_ref))
 
@@ -77,13 +71,10 @@ def test_dense_sweep_matches_compact(n, dtype):
     from tsp_mpi_reduction_tpu.ops.distance import distance_matrix_np
 
     d = jnp.asarray(distance_matrix_np(xy), dtype)
-    held_karp.set_impl("compact")
-    try:
+    with held_karp.use_impl("compact"):
         c_ref, t_ref = held_karp.solve_blocks_from_dists(d, dtype)
-        held_karp.set_impl("dense")
+    with held_karp.use_impl("dense"):
         c_got, t_got = held_karp.solve_blocks_from_dists(d, dtype)
-    finally:
-        held_karp.set_impl("auto")
     np.testing.assert_array_equal(np.asarray(c_got), np.asarray(c_ref))
     np.testing.assert_array_equal(np.asarray(t_got), np.asarray(t_ref))
 
@@ -99,11 +90,8 @@ def test_dense_sweep_matches_golden_solutions(goldens_dir):
         [[[c[1], c[2]] for c in blk] for blk in golden["blocks"]]
     )
     d = jnp.asarray(distance_matrix_np(xy))
-    held_karp.set_impl("dense")
-    try:
+    with held_karp.use_impl("dense"):
         costs, tours = held_karp.solve_blocks_from_dists(d, jnp.float64)
-    finally:
-        held_karp.set_impl("auto")
     n = xy.shape[1]
     for b, sol in enumerate(golden["block_solutions"]):
         assert float(costs[b]) == sol["cost"]

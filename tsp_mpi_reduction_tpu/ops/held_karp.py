@@ -32,6 +32,7 @@ Semantics notes for oracle parity (verified against goldens):
 
 from __future__ import annotations
 
+import contextlib
 import functools
 from dataclasses import dataclass
 from typing import Tuple
@@ -103,6 +104,18 @@ def set_impl(impl: str) -> None:
     if impl not in _IMPLS:
         raise ValueError(f"impl must be one of {_IMPLS}, got {impl!r}")
     _IMPL = "compact" if impl == "jnp" else impl
+
+
+@contextlib.contextmanager
+def use_impl(impl: str):
+    """Scoped :func:`set_impl`: restores the previous selection on exit."""
+    global _IMPL
+    prev = _IMPL
+    set_impl(impl)
+    try:
+        yield
+    finally:
+        _IMPL = prev
 
 
 def _effective_impl(dtype) -> str:
@@ -204,10 +217,7 @@ def _solve_one(
 
     # close the tour: min over m' of cost[FULL \ {m'}, m'] + d(m'+1, 0)
     full = (1 << m) - 1
-    close_rows = jnp.asarray(
-        np.array([full ^ (1 << b) for b in range(m)], dtype=np.int32)
-    )
-    totals = cost[close_rows, jnp.arange(m)] + d_back
+    totals = cost[_close_rows(m), jnp.arange(m)] + d_back
     best = jnp.argmin(totals).astype(jnp.int32)
     final_cost = totals[best]
 
@@ -219,15 +229,31 @@ def _solve_one(
 
     init = (full ^ (1 << best), best)
     _, ends = jax.lax.scan(back, init, None, length=m)
-    # tour = [0, oldest .. newest, 0] in city numbering (+1 for city-0 offset)
-    tour = jnp.concatenate(
+    return final_cost, _assemble_tour(ends)
+
+
+def _close_rows(m: int) -> jnp.ndarray:
+    """Masks ``FULL \\ {b}`` indexing the tour-closing states, b = 0..m-1."""
+    full = (1 << m) - 1
+    return jnp.asarray(
+        np.array([full ^ (1 << b) for b in range(m)], dtype=np.int32)
+    )
+
+
+def _assemble_tour(ends: jnp.ndarray) -> jnp.ndarray:
+    """Endpoint backtrack (newest→oldest) → closed tour ``[0, .., 0]``.
+
+    ``+1`` converts DP endpoint index to city number (city 0 is the anchor,
+    excluded from the DP state; reference path layout tsp.cpp:501-505).
+    Shared by every impl so the layout stays bit-identical across them.
+    """
+    return jnp.concatenate(
         [
             jnp.zeros((1,), jnp.int32),
             jnp.flip(ends).astype(jnp.int32) + 1,
             jnp.zeros((1,), jnp.int32),
         ]
     )
-    return final_cost, tour
 
 
 @functools.lru_cache(maxsize=None)
@@ -267,13 +293,7 @@ def _backtrack_recompute(
 
     init = (full ^ (1 << best), best)
     _, ends = jax.lax.scan(back, init, None, length=m)
-    return jnp.concatenate(
-        [
-            jnp.zeros((1,), jnp.int32),
-            jnp.flip(ends).astype(jnp.int32) + 1,
-            jnp.zeros((1,), jnp.int32),
-        ]
-    )
+    return _assemble_tour(ends)
 
 
 def _solve_one_dense(
@@ -340,11 +360,7 @@ def _solve_one_dense(
 
     cost, _ = jax.lax.scan(step, cost, jnp.arange(1, m))
 
-    full = s - 1
-    close_rows = jnp.asarray(
-        np.array([full ^ (1 << b) for b in range(m)], dtype=np.int32)
-    )
-    totals = cost[jnp.arange(m), close_rows] + d_back
+    totals = cost[jnp.arange(m), _close_rows(m)] + d_back
     best = jnp.argmin(totals).astype(jnp.int32)
     return totals[best], _backtrack_recompute(cost, d_sub, m, best)
 
@@ -388,9 +404,23 @@ def solve_blocks_from_dists(dists, dtype=jnp.float64) -> Tuple[jnp.ndarray, jnp.
     impl = _effective_impl(dtype)
     # the Pallas kernels only compile for TPU (Mosaic); anywhere else they
     # run in interpret mode
+    # Pallas kernels compile only for real accelerators (Mosaic); interpret
+    # mode is for CPU CI. Gate on == "cpu" so any accelerator platform
+    # string (the remote plugin also reports "tpu", but don't rely on it)
+    # takes the compiled path.
     interpret = (
-        impl in ("pallas", "fused") and jax.devices()[0].platform != "tpu"
+        impl in ("pallas", "fused") and jax.devices()[0].platform == "cpu"
     )
+    if not interpret and impl in ("pallas", "fused") and (
+        jnp.dtype(dtype) == jnp.float64
+    ):
+        # Mosaic cannot lower f64 kernels; fail with a clear remedy instead
+        # of a lowering error deep inside pallas_call.
+        raise ValueError(
+            f"impl {impl!r} cannot compile float64 on TPU (Mosaic has no f64 "
+            "support); use dtype=float32 (speed mode), or impl='compact'/"
+            "'dense' for float64 parity"
+        )
     return _solve_blocks_impl(dists, n, jnp.dtype(dtype), impl, interpret)
 
 

@@ -12,12 +12,56 @@ from __future__ import annotations
 import jax
 
 ACCELERATOR_PLATFORMS = ("tpu", "axon")
+#: Out-of-tree remote plugins whose factory init dials a network tunnel (and
+#: can hang). Builtin platforms ("tpu") must never be deregistered: jax's
+#: MLIR lowering registry treats registered factories as the known-platform
+#: set, so popping "tpu" breaks pallas/checkify imports.
+REMOTE_PLATFORMS = ("axon",)
 
 
 def _registered_platforms() -> set:
     from jax._src import xla_bridge as xb
 
     return set(xb._backend_factories.keys())
+
+
+def force_host_platform(n_devices: int | None = None) -> None:
+    """Pin this process to the CPU platform, optionally with ``n_devices``
+    virtual devices (``--xla_force_host_platform_device_count``).
+
+    In this image, sitecustomize imports jax at interpreter startup with a
+    remote-TPU ("axon") plugin, so caller env edits are read too late; this
+    forces the platform through jax.config (still honored post-import,
+    pre-backend-init) and deregisters accelerator factories so no jax op can
+    dial the tunnel. Must run before the first jax array op of the process.
+    """
+    import os
+
+    if n_devices is not None:
+        # Drop any existing count rather than relying on append-wins: a stale
+        # `=2` inherited from the environment must not shadow the request.
+        flags = [
+            f
+            for f in os.environ.get("XLA_FLAGS", "").split()
+            if not f.startswith("--xla_force_host_platform_device_count")
+        ]
+        flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+        os.environ["XLA_FLAGS"] = " ".join(flags)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    jax.config.update("jax_platforms", "cpu")
+    from jax._src import xla_bridge as xb
+
+    for p in REMOTE_PLATFORMS:  # never dial a tunnel from CPU mode
+        xb._backend_factories.pop(p, None)
+    # Mirror select_backend's phantom check: a backend cached before this
+    # call wins over every edit above, so pinning "cpu" now would be a lie.
+    if xb._backends:
+        devs = jax.devices()
+        if devs and devs[0].platform != "cpu":
+            raise RuntimeError(
+                f"jax backend already initialized to {devs[0].platform!r}; "
+                "force_host_platform must run before the first jax array op"
+            )
 
 
 def select_backend(name: str = "auto") -> str:
@@ -31,15 +75,42 @@ def select_backend(name: str = "auto") -> str:
     if name == "auto":
         name = "tpu" if accel else "cpu"
     if name == "cpu":
-        jax.config.update("jax_platforms", "cpu")
-        from jax._src import xla_bridge as xb
-
-        for p in ACCELERATOR_PLATFORMS:  # never dial a tunnel from CPU mode
-            xb._backend_factories.pop(p, None)
+        force_host_platform()
         return "cpu"
     if name == "tpu":
         if not accel:
             raise RuntimeError("no TPU platform registered in this process")
-        jax.config.update("jax_platforms", ",".join(accel))
-        return "tpu"
+        # A platform can be registered yet fail to initialize (e.g. the stock
+        # "tpu" plugin in images where the chip is reachable only through the
+        # remote "axon" plugin) — and jax does not fall through on a hard
+        # plugin-init error. Probe candidates until one actually yields
+        # devices, preferring the environment's own pin.
+        import os
+
+        env = os.environ.get("JAX_PLATFORMS", "")
+        candidates = [env] if env in accel else []
+        candidates += [p for p in accel if p not in candidates]
+        prev_platforms = jax.config.jax_platforms
+        last_err: Exception | None = None
+        for p in candidates:
+            jax.config.update("jax_platforms", p)
+            try:
+                devs = jax.devices()
+            except Exception as e:  # plugin registered but chip unreachable
+                last_err = e
+                continue
+            # jax caches the first-initialized backend: if this process
+            # already ran on CPU, devices() "succeeds" with CPU devices no
+            # matter what jax_platforms says. Don't report a phantom TPU.
+            if devs and devs[0].platform != "cpu":
+                return "tpu"
+            last_err = RuntimeError(
+                "jax backend already initialized to CPU in this process; "
+                "select the backend before the first jax array op"
+            )
+            break
+        jax.config.update("jax_platforms", prev_platforms)
+        raise RuntimeError(
+            f"no accelerator platform initialized (tried {candidates}): {last_err}"
+        )
     raise ValueError(f"unknown backend {name!r} (expected cpu|tpu|auto)")
