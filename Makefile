@@ -1,0 +1,70 @@
+# Build & benchmark harness — the reference's L0 layer re-hosted
+# (Makefile:1-21, test.sh:1-24 in /root/reference; SURVEY.md §1 row L0).
+#
+# The reference's `make` builds the MPI binary and `make run` launches
+# `mpirun -np 3 ./tsp 10 6 500 500` (Makefile:20). Here `make` builds the
+# native C++ runtime and the bit-exact CPU oracle (the unmodified reference
+# translation unit compiled out-of-tree against our single-rank MPI stub —
+# no reference code is vendored into this repo), and `make run` drives the
+# TPU-native CLI with the same config and a 3-rank-shaped merge tree.
+
+REFERENCE ?= /root/reference
+ORACLE_OUT ?= build/oracle
+PY ?= python
+CXX ?= g++
+CXXFLAGS ?= -O2 -std=c++11
+
+.PHONY: all native oracle test test-fast bench run sweep goldens clean
+
+all: native oracle
+
+# --- native C++ runtime (generator, Held-Karp, merge, pipeline) ---
+native:
+	$(MAKE) -C native
+
+# --- bit-exact oracle: reference tsp.cpp + golden dumper ---
+oracle: $(ORACLE_OUT)/tsp $(ORACLE_OUT)/dump
+
+$(ORACLE_OUT)/tsp: $(REFERENCE)/tsp.cpp $(REFERENCE)/assignment2.h oracle/mpi.h
+	@mkdir -p $(ORACLE_OUT)
+	$(CXX) $(CXXFLAGS) -Ioracle -I$(REFERENCE) $(REFERENCE)/tsp.cpp -o $@ -lm
+
+$(ORACLE_OUT)/dump: oracle/dump.cpp $(REFERENCE)/tsp.cpp $(REFERENCE)/assignment2.h oracle/mpi.h
+	@mkdir -p $(ORACLE_OUT)
+	$(CXX) $(CXXFLAGS) -Ioracle -I$(REFERENCE) -Dmain=tsp_reference_main \
+		-c $(REFERENCE)/tsp.cpp -o $(ORACLE_OUT)/tspref.o
+	$(CXX) $(CXXFLAGS) -Ioracle -I$(REFERENCE) oracle/dump.cpp \
+		$(ORACLE_OUT)/tspref.o -o $@ -lm
+
+# --- tests (CPU, 8 virtual devices; tests/conftest.py pins the platform) ---
+test:
+	$(PY) -m pytest tests/ -x -q
+
+test-fast:
+	$(PY) -m pytest tests/ -x -q -m "not slow"
+
+# --- benchmark: one JSON line on the current accelerator ---
+bench:
+	$(PY) bench.py
+
+# reference `make run` analog: same config, 3-rank-shaped merge tree
+run:
+	$(PY) -m tsp_mpi_reduction_tpu 10 6 500 500 --ranks=3
+
+# reference test.sh analog (full 1200-config sweep; see ./test.sh)
+sweep:
+	./test.sh
+
+# regenerate every golden fixture from the oracle (config parsed from the
+# fixture filename full_{ncpb}x{nblocks}_{gx}x{gy}.json)
+goldens: oracle
+	$(ORACLE_OUT)/dump rand 0 0 0 0 goldens/glibc_rand_seed0.json
+	@for f in goldens/full_*.json; do \
+		cfg=$$(basename $$f .json | sed 's/full_//; s/[x_]/ /g'); \
+		echo "dump full $$cfg -> $$f"; \
+		$(ORACLE_OUT)/dump full $$cfg $$f; \
+	done
+
+clean:
+	rm -rf build
+	$(MAKE) -C native clean

@@ -1,0 +1,89 @@
+// Golden-data dumper: links the UNMODIFIED reference translation unit
+// (tsp.cpp compiled with -Dmain=tsp_reference_main) and records its exact
+// behavior as JSON.
+#define fRand dump_fRand
+#define printMatrix dump_printMatrix
+#define printBlocked dump_printBlocked
+#define printMatrixArray dump_printMatrixArray
+#define genKey dump_genKey
+#define computeDistanceMatrix dump_computeDistanceMatrix
+#define printPath dump_printPath
+#define convPathToCityPath dump_convPathToCityPath
+#define generateSubsets dump_generateSubsets
+#include "assignment2.h"
+#undef fRand
+#undef printMatrix
+#undef printBlocked
+#undef printMatrixArray
+#undef genKey
+#undef computeDistanceMatrix
+#undef printPath
+#undef convPathToCityPath
+#undef generateSubsets
+
+extern int procNum;
+extern int numProcs;
+vector<int> getBlocksPerDim(int numBlocks);
+
+static void printCity(FILE* f, const City& c, bool last) {
+    fprintf(f, "[%d,%.17g,%.17g]%s", c.id, c.x, c.y, last ? "" : ",");
+}
+
+static void dumpSolution(FILE* f, const BlockSolution& s) {
+    fprintf(f, "{\"cost\":%.17g,\"ids\":[", s.cost);
+    for (size_t i = 0; i < s.path.size(); i++)
+        fprintf(f, "%d%s", s.path[i].id, i + 1 == s.path.size() ? "" : ",");
+    fprintf(f, "]}");
+}
+
+int main(int argc, char** argv) {
+    if (argc != 7) { fprintf(stderr, "usage: dump mode ncpb nblocks gx gy out.json\n"); return 1; }
+    const char* mode = argv[1];
+    int ncpb = atoi(argv[2]), nblocks = atoi(argv[3]), gx = atoi(argv[4]), gy = atoi(argv[5]);
+    FILE* f = fopen(argv[6], "w");
+    procNum = 0; numProcs = 1;
+    srand(0);
+
+    if (string(mode) == "rand") {
+        fprintf(f, "{\"seed\":0,\"values\":[");
+        for (int i = 0; i < 2000; i++) fprintf(f, "%d%s", rand(), i == 1999 ? "" : ",");
+        fprintf(f, "]}\n");
+        fclose(f); return 0;
+    }
+
+    vector<int> dims = getBlocksPerDim(nblocks);
+    vector<vector<City>> blocks = distributeCities(ncpb, dims[0], dims[1], gx, gy);
+
+    fprintf(f, "{\"config\":{\"ncpb\":%d,\"nblocks\":%d,\"gx\":%d,\"gy\":%d},", ncpb, nblocks, gx, gy);
+    fprintf(f, "\"dims\":[%d,%d],", dims[0], dims[1]);
+    fprintf(f, "\"blocks\":[");
+    for (size_t b = 0; b < blocks.size(); b++) {
+        fprintf(f, "[");
+        for (size_t j = 0; j < blocks[b].size(); j++) printCity(f, blocks[b][j], j + 1 == blocks[b].size());
+        fprintf(f, "]%s", b + 1 == blocks.size() ? "" : ",");
+    }
+    fprintf(f, "]");
+
+    if (string(mode) == "full") {
+        vector<BlockSolution> sols;
+        for (size_t b = 0; b < blocks.size(); b++) sols.push_back(tsp(blocks[b]));
+        fprintf(f, ",\"block_solutions\":[");
+        for (size_t b = 0; b < sols.size(); b++) {
+            dumpSolution(f, sols[b]);
+            fprintf(f, "%s", b + 1 == sols.size() ? "" : ",");
+        }
+        fprintf(f, "],\"fold_costs\":[");
+        bool first = true;
+        while (sols.size() > 1) {
+            sols[0] = mergeBlocks(sols[0], sols[1]);
+            sols.erase(sols.begin() + 1);
+            fprintf(f, "%s%.17g", first ? "" : ",", sols[0].cost);
+            first = false;
+        }
+        fprintf(f, "],\"final\":");
+        dumpSolution(f, sols[0]);
+    }
+    fprintf(f, "}\n");
+    fclose(f);
+    return 0;
+}

@@ -1,0 +1,35 @@
+// Single-rank MPI stub for compiling the reference without an MPI toolchain.
+#ifndef MPI_STUB_H
+#define MPI_STUB_H
+#include <cstdlib>
+#include <cstdio>
+#include <cstddef>
+#include <map>   // reference relies on mpi.h transitively providing <map>
+
+typedef int MPI_Comm;
+typedef int MPI_Datatype;
+typedef long MPI_Aint;
+typedef int MPI_Request;
+typedef struct { int MPI_SOURCE, MPI_TAG, MPI_ERROR; } MPI_Status;
+
+#define MPI_COMM_WORLD 0
+#define MPI_INT 1
+#define MPI_DOUBLE 2
+
+static inline int MPI_Init(int*, char***) { return 0; }
+static inline int MPI_Finalize() { return 0; }
+static inline int MPI_Comm_rank(MPI_Comm, int* r) { *r = 0; return 0; }
+static inline int MPI_Comm_size(MPI_Comm, int* s) { *s = 1; return 0; }
+static inline int MPI_Type_create_struct(int, const int*, const MPI_Aint*,
+                                         const MPI_Datatype*, MPI_Datatype* t) { *t = 99; return 0; }
+static inline int MPI_Type_commit(MPI_Datatype*) { return 0; }
+static inline int MPI_Cart_create(MPI_Comm, int, const int*, const int*, int, MPI_Comm* c) { *c = 1; return 0; }
+static inline int MPI_Cart_coords(MPI_Comm, int, int, int* coords) { coords[0] = 0; coords[1] = 0; return 0; }
+static inline int MPI_Barrier(MPI_Comm) { return 0; }
+static inline int MPI_Send(const void*, int, MPI_Datatype, int, int, MPI_Comm) {
+    fprintf(stderr, "stub MPI_Send called at size 1\n"); abort();
+}
+static inline int MPI_Recv(void*, int, MPI_Datatype, int, int, MPI_Comm, MPI_Status*) {
+    fprintf(stderr, "stub MPI_Recv called at size 1\n"); abort();
+}
+#endif

@@ -1,0 +1,14 @@
+#!/bin/bash
+# Benchmark sweep — the reference's test.sh re-hosted (test.sh:1-24 in
+# /root/reference; SURVEY.md §3.5). Same axes (cities/block 5-10, blocks
+# 10..200 step 10, "procs" 2..20 step 2 served by the rank-emulated merge
+# tree), same 1000x1000 grid, same results.csv schema
+# `numCities,numBlocks,numProcs,time,cost`.
+#
+# Usage:
+#   ./test.sh                 # full 1200-config sweep (slow)
+#   ./test.sh --quick         # small smoke subset
+#   ./test.sh --backend=cpu   # any tools/sweep.py flag passes through
+set -euo pipefail
+cd "$(dirname "$0")"
+exec python tools/sweep.py "$@"
