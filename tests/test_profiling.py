@@ -1,0 +1,39 @@
+"""Profiling utilities: phase timers and jax.profiler trace capture."""
+
+import jax.numpy as jnp
+
+from tsp_mpi_reduction_tpu.utils.profiling import PhaseTimer, device_trace
+
+
+def test_phase_timer_accumulates_across_reentry():
+    t = PhaseTimer()
+    for _ in range(3):
+        with t.phase("work"):
+            pass
+    with t.phase("other"):
+        pass
+    assert set(t.seconds) == {"work", "other"}
+    assert t.seconds["work"] >= 0.0
+
+
+def test_phase_timer_records_on_exception():
+    t = PhaseTimer()
+    try:
+        with t.phase("boom"):
+            raise RuntimeError("x")
+    except RuntimeError:
+        pass
+    assert "boom" in t.seconds
+
+
+def test_device_trace_none_is_noop():
+    with device_trace(None):
+        assert float(jnp.zeros(2).sum()) == 0.0
+
+
+def test_device_trace_writes_profile(tmp_path):
+    d = str(tmp_path / "trace")
+    with device_trace(d):
+        jnp.arange(8.0).sum().block_until_ready()
+    files = list((tmp_path / "trace").rglob("*"))
+    assert files, "profiler trace directory is empty"

@@ -22,7 +22,6 @@ Deviations from the reference (documented, SURVEY.md quirk #6/#8):
 
 from __future__ import annotations
 
-import time
 from dataclasses import dataclass, field
 from typing import Dict, Optional
 
@@ -33,6 +32,7 @@ from ..ops.distance import distance_matrix, distance_matrix_np
 from ..ops.generator import generate_instance
 from ..ops.held_karp import build_plan, require_x64_if_float64, solve_blocks_from_dists
 from ..ops.merge import fold_tours
+from ..utils.profiling import PhaseTimer
 
 
 @dataclass
@@ -91,31 +91,27 @@ def run_pipeline(
     require_x64_if_float64(dtype)  # fail fast, before any compute
     build_plan(n)  # validates the block-size cap up front
 
-    timings: Dict[str, float] = {}
-    t0 = time.perf_counter()
-    if xy is None:
-        _, xy = generate_instance(n, num_blocks, grid_dim_x, grid_dim_y, seed)
-    timings["generate"] = time.perf_counter() - t0
+    timer = PhaseTimer()
+    with timer.phase("generate"):
+        if xy is None:
+            _, xy = generate_instance(n, num_blocks, grid_dim_x, grid_dim_y, seed)
 
-    t0 = time.perf_counter()
-    if dtype == jnp.float64:
-        dist = jnp.asarray(distance_matrix_np(xy.reshape(-1, 2)))
-    else:
-        dist = distance_matrix(jnp.asarray(xy.reshape(-1, 2), dtype))
-    block_d = block_distance_slices(dist, num_blocks, n)
-    timings["distances"] = time.perf_counter() - t0
+    with timer.phase("distances"):
+        if dtype == jnp.float64:
+            dist = jnp.asarray(distance_matrix_np(xy.reshape(-1, 2)))
+        else:
+            dist = distance_matrix(jnp.asarray(xy.reshape(-1, 2), dtype))
+        block_d = block_distance_slices(dist, num_blocks, n)
 
-    t0 = time.perf_counter()
-    costs, local_tours = solve_blocks_from_dists(block_d, dtype)
-    costs.block_until_ready()
-    timings["solve"] = time.perf_counter() - t0
+    with timer.phase("solve"):
+        costs, local_tours = solve_blocks_from_dists(block_d, dtype)
+        costs.block_until_ready()
 
-    t0 = time.perf_counter()
-    offsets = (jnp.arange(num_blocks, dtype=jnp.int32) * n)[:, None]
-    global_tours = local_tours.astype(jnp.int32) + offsets
-    ids, length, cost = fold_tours(global_tours, costs, dist)
-    cost.block_until_ready()
-    timings["merge_fold"] = time.perf_counter() - t0
+    with timer.phase("merge_fold"):
+        offsets = (jnp.arange(num_blocks, dtype=jnp.int32) * n)[:, None]
+        global_tours = local_tours.astype(jnp.int32) + offsets
+        ids, length, cost = fold_tours(global_tours, costs, dist)
+        cost.block_until_ready()
 
     plan = build_plan(n)
     final_len = int(length)
@@ -124,7 +120,7 @@ def run_pipeline(
         tour_ids=np.asarray(ids)[:final_len],
         num_cities=num_blocks * n,
         block_costs=np.asarray(costs),
-        phase_seconds=timings,
+        phase_seconds=timer.seconds,
         dp_states=plan.dp_states * num_blocks,
         dp_transitions=plan.dp_transitions * num_blocks,
     )

@@ -53,6 +53,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dtype", default=None, choices=["float64", "float32"])
     p.add_argument("--metrics", action="store_true")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument(
+        "--trace",
+        default=None,
+        metavar="DIR",
+        help="dump a jax.profiler trace (TensorBoard/Perfetto) to DIR",
+    )
     return p
 
 
@@ -113,6 +119,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     n, nb = args.numCitiesPerBlock, args.numBlocks
     if args.backend == "native":
         # pure C++ host path (native/): no jax import, double precision only
+        if args.trace:
+            print(
+                "error: --trace needs a jax backend (not --backend=native)",
+                file=sys.stderr,
+            )
+            return 2
         if args.dtype == "float32":
             print(
                 "error: --backend=native runs float64 only (drop --dtype)",
@@ -166,16 +178,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     rows, cols = get_blocks_per_dim(nb)
     print(reporting.dims_line(rows, cols))
 
+    from .profiling import device_trace
+
     try:
-        if args.ranks > 1:
-            res = run_pipeline_ranks(
-                n, nb, args.gridDimX, args.gridDimY, args.ranks,
-                seed=args.seed, dtype=dtype,
-            )
-        else:
-            res = run_pipeline(
-                n, nb, args.gridDimX, args.gridDimY, seed=args.seed, dtype=dtype
-            )
+        with device_trace(args.trace):
+            if args.ranks > 1:
+                res = run_pipeline_ranks(
+                    n, nb, args.gridDimX, args.gridDimY, args.ranks,
+                    seed=args.seed, dtype=dtype,
+                )
+            else:
+                res = run_pipeline(
+                    n, nb, args.gridDimX, args.gridDimY,
+                    seed=args.seed, dtype=dtype,
+                )
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2

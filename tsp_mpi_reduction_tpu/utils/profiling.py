@@ -1,0 +1,56 @@
+"""Tracing & profiling (SURVEY.md §5 row 1).
+
+The reference's only observability is one wall-clock timer wrapped around
+everything including ``MPI_Init`` (tsp.cpp:275-276,360-363). Here every
+pipeline reports per-phase seconds (``PipelineResult.phase_seconds``), DP
+state/transition counts (the north-star nodes/sec metric), and — via
+``device_trace`` — full ``jax.profiler`` traces viewable in TensorBoard /
+Perfetto for kernel-level TPU timing.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Iterator, Optional
+
+
+class PhaseTimer:
+    """Accumulates wall-clock seconds per named phase.
+
+    >>> timer = PhaseTimer()
+    >>> with timer.phase("solve"):
+    ...     ...
+    >>> timer.seconds  # {"solve": 0.123}
+
+    Re-entering a phase name accumulates (useful across B&B iterations).
+    """
+
+    def __init__(self) -> None:
+        self.seconds: Dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.seconds[name] = self.seconds.get(name, 0.0) + (
+                time.perf_counter() - t0
+            )
+
+
+@contextlib.contextmanager
+def device_trace(trace_dir: Optional[str]) -> Iterator[None]:
+    """``jax.profiler.trace`` scoped to the block; no-op when dir is None.
+
+    The dump is TensorBoard-loadable (``tensorboard --logdir <dir>``) and
+    includes XLA kernel timelines on TPU.
+    """
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(trace_dir):
+        yield
