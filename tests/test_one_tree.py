@@ -1,0 +1,114 @@
+"""Held-Karp 1-tree bound: MST correctness, bound validity, B&B speedup."""
+
+import itertools
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tsp_mpi_reduction_tpu.models import branch_bound as bb
+from tsp_mpi_reduction_tpu.ops.one_tree import (
+    bound_arrays,
+    held_karp_potentials,
+    mst_cost_degrees,
+    one_tree_cost_degrees,
+)
+from tsp_mpi_reduction_tpu.utils.tsplib import burma14
+
+
+def _prim_reference(d: np.ndarray) -> float:
+    """Independent host Prim (different code path from the jax fori_loop)."""
+    m = d.shape[0]
+    in_tree = {0}
+    cost = 0.0
+    while len(in_tree) < m:
+        best = min(
+            ((d[i, j], j) for i in in_tree for j in range(m) if j not in in_tree),
+        )
+        cost += best[0]
+        in_tree.add(best[1])
+    return cost
+
+
+def _random_metric(n: int, seed: int) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    xy = rng.uniform(0, 100, (n, 2))
+    d = np.hypot(*(xy[:, None, :] - xy[None, :, :]).transpose(2, 0, 1))
+    return d
+
+
+@pytest.mark.parametrize("m,seed", [(4, 0), (7, 1), (12, 2), (20, 3)])
+def test_mst_matches_reference_prim(m, seed):
+    d = _random_metric(m, seed)
+    dj = jnp.asarray(np.where(np.eye(m, dtype=bool), np.inf, d), jnp.float64)
+    cost, deg = mst_cost_degrees(dj)
+    assert float(cost) == pytest.approx(_prim_reference(d), rel=1e-12)
+    assert int(deg.sum()) == 2 * (m - 1)  # tree has m-1 edges
+
+
+def test_one_tree_has_n_edges_and_degree_two_at_root():
+    n = 9
+    d = _random_metric(n, 4)
+    dj = jnp.asarray(np.where(np.eye(n, dtype=bool), np.inf, d), jnp.float64)
+    cost, deg = one_tree_cost_degrees(dj)
+    assert int(deg[0]) == 2
+    assert int(deg.sum()) == 2 * n  # n edges total
+    # 1-tree with pi=0 lower-bounds the optimal tour (brute force, n small)
+    best = min(
+        sum(d[p[i], p[i + 1]] for i in range(n - 1)) + d[p[-1], p[0]]
+        for p in itertools.permutations(range(1, n))
+        for p in [(0,) + p]
+    )
+    assert float(cost) <= best + 1e-9
+
+
+@pytest.mark.parametrize("n,seed", [(8, 5), (10, 6)])
+def test_potentials_tighten_but_stay_valid(n, seed):
+    d = _random_metric(n, seed)
+    dj = jnp.asarray(d, jnp.float64)
+    pi, lb = held_karp_potentials(dj, steps=100)
+    d_inf = jnp.asarray(np.where(np.eye(n, dtype=bool), np.inf, d), jnp.float64)
+    plain, _ = one_tree_cost_degrees(d_inf)
+    best = min(
+        sum(d[p[i], p[i + 1]] for i in range(n - 1)) + d[p[-1], p[0]]
+        for p in itertools.permutations(range(1, n))
+        for p in [(0,) + p]
+    )
+    assert float(lb) <= best + 1e-6  # valid
+    assert float(lb) >= float(plain) - 1e-9  # at least the pi=0 value
+
+
+def test_bound_arrays_zero_pi_reduces_to_min_out():
+    d = _random_metric(6, 7)
+    dj = jnp.asarray(d, jnp.float64)
+    w, adj = bound_arrays(dj, jnp.zeros(6, jnp.float64))
+    min_out = np.where(np.eye(6, dtype=bool), np.inf, d).min(1)
+    np.testing.assert_allclose(np.asarray(w), min_out, rtol=1e-12)
+    np.testing.assert_allclose(np.asarray(adj), np.zeros(6), atol=0)
+
+
+def test_burma14_one_tree_bound_is_tight():
+    d = burma14().distance_matrix()
+    pi, lb = held_karp_potentials(jnp.asarray(d, jnp.float32), steps=150)
+    # burma14 optimum is 3323; the HK bound is famously within ~1%
+    assert 3200.0 <= float(lb) <= 3323.0 + 1e-3
+
+
+def test_bnb_one_tree_matches_min_out_and_prunes_harder():
+    d = burma14().distance_matrix()
+    r_mo = bb.solve(d, capacity=1 << 15, k=64, inner_steps=8, bound="min-out")
+    r_ot = bb.solve(d, capacity=1 << 15, k=64, inner_steps=8, bound="one-tree")
+    assert r_mo.proven_optimal and r_ot.proven_optimal
+    assert round(r_mo.cost) == round(r_ot.cost) == 3323
+    assert r_ot.nodes_expanded < r_mo.nodes_expanded
+    assert r_ot.root_lower_bound > 3200.0
+
+
+def test_checkpoint_refuses_other_bound(tmp_path):
+    d = _random_metric(9, 8)
+    ck = str(tmp_path / "ck.npz")
+    bb.solve(d, capacity=1 << 10, k=16, inner_steps=2, max_iters=2,
+             checkpoint_path=ck, bound="one-tree")
+    with pytest.raises(ValueError, match="bound"):
+        bb.solve(d, capacity=1 << 10, k=16, inner_steps=2,
+                 resume_from=ck, bound="min-out")

@@ -32,9 +32,18 @@ def main() -> int:
     ap.add_argument("--checkpoint", default=None)
     ap.add_argument("--checkpoint-every", type=int, default=0)
     ap.add_argument("--resume", default=None)
+    ap.add_argument("--bound", default="one-tree", choices=["one-tree", "min-out"])
     args = ap.parse_args()
 
-    select_backend(args.backend)
+    platform = select_backend(args.backend)
+    if platform == "cpu" and args.ranks > 1:
+        # CPU can host an arbitrary virtual mesh — provision one device per
+        # requested rank (the conftest trick, SURVEY.md §4). Keyed on the
+        # RESOLVED platform so --backend=auto works on CPU-only hosts; safe
+        # here because no jax op has initialized the backend yet.
+        from tsp_mpi_reduction_tpu.utils.backend import force_host_platform
+
+        force_host_platform(args.ranks)
 
     from tsp_mpi_reduction_tpu.models import branch_bound as bb
     from tsp_mpi_reduction_tpu.utils import tsplib
@@ -65,6 +74,7 @@ def main() -> int:
             k=args.k,
             inner_steps=args.inner_steps,
             time_limit_s=args.time_limit,
+            bound=args.bound,
         )
     else:
         res = bb.solve(
@@ -76,6 +86,7 @@ def main() -> int:
             checkpoint_path=args.checkpoint,
             checkpoint_every=args.checkpoint_every,
             resume_from=args.resume,
+            bound=args.bound,
         )
 
     opt = inst.known_optimum
@@ -93,6 +104,13 @@ def main() -> int:
                 "time_to_best_s": round(res.time_to_best, 4),
                 "wall_s": round(res.wall_seconds, 3),
                 "ranks": args.ranks,
+                "bound": args.bound,
+                "root_lower_bound": round(res.root_lower_bound, 3),
+                "gap": (
+                    round(res.cost - res.root_lower_bound, 3)
+                    if res.root_lower_bound > -1e30
+                    else None
+                ),
             }
         )
     )

@@ -18,10 +18,12 @@ Design (TPU-first):
   detects completed tours, and pushes survivors sorted worst-bound-first so
   the next pop explores best-bound-first. No data-dependent shapes: pruned
   lanes are masked, the push uses a prefix-sum scatter.
-- Admissible lower bound: ``cost + min_out[cur] + sum(min_out[unvisited])``
-  (every city still to be left contributes at least its cheapest outgoing
-  edge). The running ``sum(min_out[unvisited])`` is carried in the state so
-  the child bound is one add.
+- Admissible lower bound: every city still to be left contributes at least
+  its cheapest outgoing edge; the running sum over unvisited cities is
+  carried in the state so the child bound is one add. By default the edge
+  metric is reshaped by Held-Karp 1-tree potentials (``bound="one-tree"``,
+  ops.one_tree) — typically orders of magnitude fewer nodes at identical
+  kernel cost; ``bound="min-out"`` is the plain metric.
 - The incumbent starts from a host-side nearest-neighbor + 2-opt tour, so
   pruning is strong from step one.
 - The host loop only reads back two scalars per iteration (frontier count,
@@ -69,6 +71,9 @@ class BnBResult:
     wall_seconds: float
     nodes_per_sec: float
     time_to_best: float
+    #: proven lower bound at the root (1-tree value; min-out sum otherwise) —
+    #: reported so callers can state the optimality gap when stopping early
+    root_lower_bound: float = -np.inf
 
 
 def nearest_neighbor_tour(d: np.ndarray) -> np.ndarray:
@@ -111,6 +116,44 @@ def tour_cost(d: np.ndarray, tour: np.ndarray) -> float:
     return float(d[tour[:-1], tour[1:]].sum())
 
 
+def _bound_setup(d, bound: str):
+    """Per-city weights + per-child adjustment + root LB for a bound mode.
+
+    "min-out": weights = cheapest outgoing edge, adjustment = 0.
+    "one-tree": Held-Karp potentials (ops.one_tree) reshape the metric —
+    weights = min reduced outgoing edge - 2*pi, adjustment = pi - pi[0] —
+    which typically prunes orders of magnitude harder at identical kernel
+    cost. Both return float32 device arrays for the expansion kernel.
+    """
+    n = d.shape[0]
+    d64 = np.asarray(d, np.float64)
+    eye = np.eye(n, dtype=bool)
+    if bound == "min-out":
+        w = np.where(eye, np.inf, d64).min(1)
+        adj = np.zeros(n)
+        root_lb = float(w.sum())  # every city is left once
+    elif bound == "one-tree":
+        from ..ops.one_tree import bound_arrays, held_karp_potentials
+
+        d32 = jnp.asarray(d64, jnp.float32)
+        pi, lb = held_karp_potentials(d32, steps=150)
+        w_j, adj_j = bound_arrays(d32, pi)
+        w = np.asarray(w_j, np.float64)
+        adj = np.asarray(adj_j, np.float64)
+        # float32 safety slack: node bounds are f32 sums of ~n weight terms,
+        # so shave n ulps off the per-child adjustment — rounding must never
+        # push a bound past the incumbent and prune the true optimum. The
+        # reported root bound gets the same shave so it stays a true lower
+        # bound despite the f32 ascent.
+        scale = float(np.abs(w).max()) + float(np.abs(adj).max()) + 1.0
+        slack = n * float(np.spacing(np.float32(scale)))
+        root_lb = float(lb) - slack
+        adj = adj - slack
+    else:
+        raise ValueError(f"bound must be 'one-tree' or 'min-out', got {bound!r}")
+    return jnp.asarray(w, jnp.float32), jnp.asarray(adj, jnp.float32), root_lb
+
+
 @partial(jax.jit, static_argnames=("k", "n"))
 def _expand_step(
     fr: Frontier,
@@ -118,6 +161,7 @@ def _expand_step(
     inc_tour: jnp.ndarray,
     d: jnp.ndarray,
     min_out: jnp.ndarray,
+    bound_adj: jnp.ndarray,
     k: int,
     n: int,
 ):
@@ -140,8 +184,10 @@ def _expand_step(
     unvis = (p_mask[:, None] >> cities[None, :].astype(jnp.uint32)) & 1 == 0
     feasible = unvis & live[:, None]
     ccost = p_cost[:, None] + d[cur]  # d[cur] is the [k, n] outgoing-edge block
-    # child bound: ccost + sum over must-leave cities (child + remaining)
-    cbound = ccost + p_sum[:, None]
+    # child bound: ccost + sum over must-leave cities (child + remaining),
+    # plus the per-child potential correction (zeros in plain min-out mode,
+    # pi[child] - pi[0] under the 1-tree bound — ops.one_tree.bound_arrays)
+    cbound = ccost + p_sum[:, None] + bound_adj[None, :]
     cdepth = p_depth[:, None] + 1
 
     # completions: child is the last unvisited city -> close to 0
@@ -211,6 +257,7 @@ def _expand_loop(
     inc_tour: jnp.ndarray,
     d: jnp.ndarray,
     min_out: jnp.ndarray,
+    bound_adj: jnp.ndarray,
     k: int,
     n: int,
     inner_steps: int,
@@ -227,7 +274,9 @@ def _expand_loop(
 
     def body(carry):
         fr, ic, itour, nodes, i = carry
-        fr, ic, itour, stats = _expand_step(fr, ic, itour, d, min_out, k, n)
+        fr, ic, itour, stats = _expand_step(
+            fr, ic, itour, d, min_out, bound_adj, k, n
+        )
         return fr, ic, itour, nodes + stats["popped"], i + 1
 
     # derive the zero carries from fr.count so their varying-axis type
@@ -263,22 +312,27 @@ def solve(
     checkpoint_path: Optional[str] = None,
     checkpoint_every: int = 0,
     resume_from: Optional[str] = None,
+    bound: str = "one-tree",
 ) -> BnBResult:
     """Exact B&B on one device. ``d`` is a dense [n, n] distance matrix.
+
+    ``bound``: "one-tree" (default — Held-Karp potentials sharpen every
+    node bound, usually orders of magnitude fewer nodes) or "min-out"
+    (the plain cheapest-outgoing-edge bound).
 
     Stops when the frontier empties (proven optimal), or at
     ``max_iters``/``time_limit_s``/``target_cost`` (then best-so-far).
     """
     n = d.shape[0]
-    if n > 32:
-        # visited sets are uint32 bitmasks
-        raise ValueError(f"B&B engine supports n <= 32 cities, got {n}")
+    if not 3 <= n <= 32:
+        # visited sets are uint32 bitmasks; 1-tree needs >= 3 vertices
+        raise ValueError(f"B&B engine supports 3 <= n <= 32 cities, got {n}")
     d32 = jnp.asarray(d, jnp.float32)
-    min_out_np = np.where(np.eye(n, dtype=bool), np.inf, np.asarray(d, np.float64)).min(1)
-    min_out = jnp.asarray(min_out_np, jnp.float32)
+    min_out, bound_adj, root_lb = _bound_setup(d, bound)
+    min_out_np = np.asarray(min_out, np.float64)
 
     if resume_from:
-        fr, inc_cost, inc_tour = restore(resume_from, expect_d=d)
+        fr, inc_cost, inc_tour = restore(resume_from, expect_d=d, expect_bound=bound)
     else:
         inc_tour_np = two_opt(
             np.asarray(d, np.float64), nearest_neighbor_tour(np.asarray(d))
@@ -297,7 +351,7 @@ def solve(
     inner = max(1, inner_steps)
     while it < max_iters:
         fr, inc_cost, inc_tour, popped = _expand_loop(
-            fr, inc_cost, inc_tour, d32, min_out, k, n, inner
+            fr, inc_cost, inc_tour, d32, min_out, bound_adj, k, n, inner
         )
         nodes += int(popped)
         it += inner
@@ -307,7 +361,7 @@ def solve(
             last_inc = ic
             t_best = time.perf_counter() - t0
         if checkpoint_every and checkpoint_path and it % max(checkpoint_every, inner) < inner:
-            save(checkpoint_path, fr, inc_cost, inc_tour, d=d)
+            save(checkpoint_path, fr, inc_cost, inc_tour, d=d, bound=bound)
         if cnt == 0:
             break
         if time_limit_s is not None and time.perf_counter() - t0 > time_limit_s:
@@ -319,7 +373,7 @@ def solve(
     if checkpoint_path and not proven:
         # always leave a resumable snapshot when stopping early (time limit,
         # iteration cap, target reached)
-        save(checkpoint_path, fr, inc_cost, inc_tour, d=d)
+        save(checkpoint_path, fr, inc_cost, inc_tour, d=d, bound=bound)
     return BnBResult(
         cost=float(inc_cost),
         tour=np.asarray(inc_tour),
@@ -329,6 +383,7 @@ def solve(
         wall_seconds=wall,
         nodes_per_sec=nodes / wall if wall > 0 else 0.0,
         time_to_best=t_best,
+        root_lower_bound=root_lb,
     )
 
 
@@ -340,6 +395,7 @@ def solve_sharded(
     inner_steps: int = 32,
     max_iters: int = 200_000,
     time_limit_s: Optional[float] = None,
+    bound: str = "one-tree",
 ) -> BnBResult:
     """Mesh-parallel B&B: per-rank frontiers, collective incumbent sharing.
 
@@ -357,13 +413,13 @@ def solve_sharded(
     from ..parallel.mesh import RANK_AXIS
 
     n = d.shape[0]
-    if n > 32:
-        raise ValueError(f"B&B engine supports n <= 32 cities, got {n}")
+    if not 3 <= n <= 32:
+        raise ValueError(f"B&B engine supports 3 <= n <= 32 cities, got {n}")
     num_ranks = int(mesh.devices.size)
     d32 = jnp.asarray(d, jnp.float32)
     d_np = np.asarray(d, np.float64)
-    min_out_np = np.where(np.eye(n, dtype=bool), np.inf, d_np).min(1)
-    min_out = jnp.asarray(min_out_np, jnp.float32)
+    min_out, bound_adj, root_lb = _bound_setup(d, bound)
+    min_out_np = np.asarray(min_out, np.float64)
 
     inc_tour_np = two_opt(d_np, nearest_neighbor_tour(d_np))
     inc_cost0 = tour_cost(d_np, inc_tour_np)
@@ -385,7 +441,7 @@ def solve_sharded(
             mask[slot] = np.uint32(1 | (1 << c))
             depth[slot] = 2
             cost[slot] = d_np[0, c]
-            bound[slot] = d_np[0, c] + sum_min0
+            bound[slot] = d_np[0, c] + sum_min0 + float(bound_adj[c])
             sum_min[slot] = sum_min0 - min_out_np[c]
         leaves["path"].append(path)
         leaves["mask"].append(mask)
@@ -402,10 +458,10 @@ def solve_sharded(
         np.broadcast_to(inc_tour_np, (num_ranks, n + 1)).copy(), spec
     )
 
-    def rank_body(fr_stacked, ic_l, itour_l, d_rep, mo_rep):
+    def rank_body(fr_stacked, ic_l, itour_l, d_rep, mo_rep, ba_rep):
         local = Frontier(*(x[0] for x in fr_stacked))
         f2, c2, t2, nodes = _expand_loop(
-            local, ic_l[0], itour_l[0], d_rep, mo_rep, k, n, inner_steps
+            local, ic_l[0], itour_l[0], d_rep, mo_rep, ba_rep, k, n, inner_steps
         )
         all_c = jax.lax.all_gather(c2, RANK_AXIS)
         all_t = jax.lax.all_gather(t2, RANK_AXIS)
@@ -430,6 +486,7 @@ def solve_sharded(
                 P(RANK_AXIS),
                 P(None, None),
                 P(None),
+                P(None),
             ),
             out_specs=(
                 tuple(P(RANK_AXIS) for _ in Frontier._fields),
@@ -447,7 +504,7 @@ def solve_sharded(
     nodes = 0
     it = 0
     while it < max_iters:
-        out = step(tuple(fr), ic, itour, d32, min_out)
+        out = step(tuple(fr), ic, itour, d32, min_out, bound_adj)
         fr = Frontier(*out[0])
         ic, itour, total, step_nodes = out[1], out[2], out[3], out[4]
         nodes += int(step_nodes[0])
@@ -472,6 +529,7 @@ def solve_sharded(
         wall_seconds=wall,
         nodes_per_sec=nodes / wall if wall > 0 else 0.0,
         time_to_best=t_best,
+        root_lower_bound=root_lb,
     )
 
 
@@ -485,7 +543,7 @@ def _d_fingerprint(d) -> np.ndarray:
     return np.asarray([d.shape[0], float(d.sum()), float(d.std())])
 
 
-def save(path: str, fr: Frontier, inc_cost, inc_tour, d=None) -> None:
+def save(path: str, fr: Frontier, inc_cost, inc_tour, d=None, bound=None) -> None:
     """Checkpoint frontier + incumbent (+ instance fingerprint) to ``.npz``."""
     payload = {
         "inc_cost": np.asarray(inc_cost),
@@ -494,17 +552,30 @@ def save(path: str, fr: Frontier, inc_cost, inc_tour, d=None) -> None:
     }
     if d is not None:
         payload["d_fingerprint"] = _d_fingerprint(d)
+    if bound is not None:
+        payload["bound_mode"] = np.asarray(bound)
     np.savez_compressed(_norm_ckpt_path(path), **payload)
 
 
-def restore(path: str, expect_d=None) -> Tuple[Frontier, jnp.ndarray, jnp.ndarray]:
-    """Load a checkpoint; refuses one written for a different instance."""
+def restore(
+    path: str, expect_d=None, expect_bound=None
+) -> Tuple[Frontier, jnp.ndarray, jnp.ndarray]:
+    """Load a checkpoint; refuses one written for a different instance or
+    (the frontier's carried sums are bound-specific) a different bound."""
     z = np.load(_norm_ckpt_path(path))
     if expect_d is not None and "d_fingerprint" in z:
         if not np.allclose(z["d_fingerprint"], _d_fingerprint(expect_d)):
             raise ValueError(
                 f"checkpoint {path!r} was written for a different instance "
                 "(distance-matrix fingerprint mismatch)"
+            )
+    if expect_bound is not None:
+        # checkpoints predating the bound_mode key could only be min-out
+        saved = str(z["bound_mode"]) if "bound_mode" in z else "min-out"
+        if saved != expect_bound:
+            raise ValueError(
+                f"checkpoint {path!r} was written with bound={saved!r}; "
+                f"resume with the same bound (got {expect_bound!r})"
             )
     fr = Frontier(*(jnp.asarray(z[f]) for f in Frontier._fields))
     return fr, jnp.asarray(z["inc_cost"]), jnp.asarray(z["inc_tour"])
