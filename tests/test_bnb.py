@@ -62,12 +62,44 @@ def test_greedy_init_tools():
     assert sorted(improved[:-1].tolist()) == list(range(20))
 
 
-def test_rejects_large_n():
+def test_rejects_out_of_range_n():
     with pytest.raises(ValueError):
-        bb.solve(np.ones((33, 33)))
+        bb.solve(np.ones((bb.MAX_BNB_CITIES + 1,) * 2))
+    with pytest.raises(ValueError):
+        bb.solve(np.ones((2, 2)))
 
 
 def test_target_cost_early_stop():
     d = random_d(12, 4)
     res = bb.solve(d, capacity=1 << 14, k=64, target_cost=1e9)
     assert res.iterations <= 64  # stops on first sync at target
+
+
+def test_multiword_mask_circle36_proves_analytic_optimum():
+    """n=36 needs two mask words; on a circle the optimal tour is the
+    perimeter (visiting in angular order), so exactness is checkable."""
+    n, r = 36, 100.0
+    th = 2 * np.pi * np.arange(n) / n
+    xy = np.stack([r * np.cos(th), r * np.sin(th)], 1)
+    d = np.hypot(*(xy[:, None] - xy[None, :]).transpose(2, 0, 1))
+    opt = n * 2 * r * np.sin(np.pi / n)
+    res = bb.solve(d, capacity=1 << 14, k=64, inner_steps=8, time_limit_s=60)
+    assert res.cost == pytest.approx(opt, rel=1e-5)
+    tour = res.tour
+    assert sorted(tour[:-1].tolist()) == list(range(n))
+    assert res.root_lower_bound <= res.cost
+
+
+def test_multiword_mask_large_instance_smoke():
+    """n=52-class instance (berlin52 size): engine runs, yields a valid
+    closed tour and a consistent bound, within a short time limit."""
+    rng = np.random.default_rng(52)
+    xy = rng.uniform(0, 1000, (52, 2))
+    d = np.hypot(*(xy[:, None] - xy[None, :]).transpose(2, 0, 1))
+    res = bb.solve(d, capacity=1 << 13, k=64, inner_steps=8, time_limit_s=5)
+    tour = res.tour
+    assert tour[0] == tour[-1] == 0
+    assert sorted(tour[:-1].tolist()) == list(range(52))
+    assert res.cost == pytest.approx(bb.tour_cost(d, tour), rel=1e-5)
+    assert res.root_lower_bound <= res.cost
+    assert res.nodes_per_sec > 0

@@ -24,8 +24,9 @@ Design (TPU-first):
   metric is reshaped by Held-Karp 1-tree potentials (``bound="one-tree"``,
   ops.one_tree) — typically orders of magnitude fewer nodes at identical
   kernel cost; ``bound="min-out"`` is the plain metric.
-- The incumbent starts from a host-side nearest-neighbor + 2-opt tour, so
-  pruning is strong from step one.
+- The incumbent starts from the best of a multistart nearest-neighbor
+  batch, each polished by the device 2-opt kernel (``strong_incumbent``),
+  so pruning is strong from step one.
 - The host loop only reads back two scalars per iteration (frontier count,
   incumbent) — the expansion itself never syncs.
 - Multi-rank: ``expand_step`` composes with ``shard_map`` by giving each
@@ -52,7 +53,7 @@ INF = jnp.inf
 
 class Frontier(NamedTuple):
     path: jnp.ndarray  # [F, n] int32 city prefix (undefined past depth)
-    mask: jnp.ndarray  # [F] uint32 visited bitmask
+    mask: jnp.ndarray  # [F, W] uint32 visited bitmask, W = ceil(n/32) words
     depth: jnp.ndarray  # [F] int32
     cost: jnp.ndarray  # [F] float32 prefix cost
     bound: jnp.ndarray  # [F] float32 admissible lower bound
@@ -76,18 +77,43 @@ class BnBResult:
     root_lower_bound: float = -np.inf
 
 
-def nearest_neighbor_tour(d: np.ndarray) -> np.ndarray:
+def nearest_neighbor_tour(d: np.ndarray, start: int = 0) -> np.ndarray:
     n = d.shape[0]
     visited = np.zeros(n, bool)
-    tour = [0]
-    visited[0] = True
+    tour = [start]
+    visited[start] = True
     for _ in range(n - 1):
         cur = tour[-1]
         cand = np.where(visited, np.inf, d[cur])
         nxt = int(np.argmin(cand))
         tour.append(nxt)
         visited[nxt] = True
-    return np.asarray(tour + [0], dtype=np.int32)
+    return np.asarray(tour + [tour[0]], dtype=np.int32)
+
+
+def strong_incumbent(d: np.ndarray, starts: int = 8) -> np.ndarray:
+    """Best of ``starts`` nearest-neighbor tours, each polished by the
+    device 2-opt kernel in one vmapped batch (ops.local_search).
+
+    Returns a closed [n+1] tour rotated to start at city 0. Costs are
+    re-measured on host in float64, so the incumbent fed to the pruner is
+    a true tour cost regardless of the f32 polish.
+    """
+    from ..ops.local_search import two_opt_batch
+
+    n = d.shape[0]
+    d64 = np.asarray(d, np.float64)
+    ss = sorted(set(np.linspace(0, n - 1, min(starts, n)).astype(int).tolist()))
+    opens = np.stack([nearest_neighbor_tour(d64, s)[:-1] for s in ss])
+    polished, _ = two_opt_batch(
+        jnp.asarray(opens, jnp.int32), jnp.asarray(d, jnp.float32)
+    )
+    polished = np.asarray(polished)
+    costs = [tour_cost(d64, np.concatenate([t, t[:1]])) for t in polished]
+    best = polished[int(np.argmin(costs))]
+    rot = int(np.argwhere(best == 0)[0, 0])
+    open0 = np.roll(best, -rot)
+    return np.concatenate([open0, open0[:1]]).astype(np.int32)
 
 
 def two_opt(d: np.ndarray, tour: np.ndarray, max_rounds: int = 200) -> np.ndarray:
@@ -114,6 +140,29 @@ def two_opt(d: np.ndarray, tour: np.ndarray, max_rounds: int = 200) -> np.ndarra
 
 def tour_cost(d: np.ndarray, tour: np.ndarray) -> float:
     return float(d[tour[:-1], tour[1:]].sum())
+
+
+MAX_BNB_CITIES = 128  # 4 mask words; covers kroA100/pr124 (BASELINE configs)
+
+
+def _mask_consts(n: int):
+    """Static per-``n`` helpers for the [W]-word visited bitmask.
+
+    Returns (W, word_idx[n], bit[n], set_bit[n, W]): city j lives in word
+    ``word_idx[j]`` at bit ``bit[j]``; OR-ing ``set_bit[j]`` into a mask
+    visits j. All become jaxpr constants under jit (n is static).
+    """
+    w = (n + 31) // 32
+    word_idx = np.arange(n) // 32
+    bit = np.arange(n) % 32
+    set_bit = np.zeros((n, w), np.uint32)
+    set_bit[np.arange(n), word_idx] = np.uint32(1) << bit.astype(np.uint32)
+    return (
+        w,
+        jnp.asarray(word_idx, jnp.int32),
+        jnp.asarray(bit, jnp.uint32),
+        jnp.asarray(set_bit),
+    )
 
 
 def _bound_setup(d, bound: str):
@@ -180,8 +229,10 @@ def _expand_step(
     p_sum = fr.sum_min[idx]
     cur = p_path[lanes, jnp.maximum(p_depth - 1, 0)]
 
+    _, word_idx, bit, set_bit = _mask_consts(n)
     cities = jnp.arange(n, dtype=jnp.int32)
-    unvis = (p_mask[:, None] >> cities[None, :].astype(jnp.uint32)) & 1 == 0
+    # p_mask is [k, W]; gather each city's word, then test its bit
+    unvis = (p_mask[:, word_idx] >> bit[None, :]) & 1 == 0
     feasible = unvis & live[:, None]
     ccost = p_cost[:, None] + d[cur]  # d[cur] is the [k, n] outgoing-edge block
     # child bound: ccost + sum over must-leave cities (child + remaining),
@@ -206,7 +257,7 @@ def _expand_step(
 
     # pushable children: feasible, not complete, bound under incumbent
     push = feasible & ~is_complete & (cbound < new_inc_cost)
-    child_mask = p_mask[:, None] | (jnp.uint32(1) << cities[None, :].astype(jnp.uint32))
+    child_mask = p_mask[:, None, :] | set_bit[None, :, :]  # [k, n, W]
     child_sum = p_sum[:, None] - min_out[None, :]
     child_path = jnp.broadcast_to(p_path[:, None, :], (k, n, n))
     child_path = jnp.where(
@@ -231,7 +282,7 @@ def _expand_step(
         return buf.at[dest].set(vals[order], mode="drop")
 
     new_path = scat(fr.path, child_path.reshape(-1, n))
-    new_mask = scat(fr.mask, child_mask.reshape(-1))
+    new_mask = scat(fr.mask, child_mask.reshape(-1, child_mask.shape[-1]))
     new_depth = scat(fr.depth, jnp.broadcast_to(cdepth, (k, n)).reshape(-1))
     new_cost = scat(fr.cost, ccost.reshape(-1))
     new_bound = scat(fr.bound, cbound.reshape(-1))
@@ -289,8 +340,9 @@ def _expand_loop(
 
 
 def make_root_frontier(n: int, capacity: int, min_out: np.ndarray, dtype=jnp.float32) -> Frontier:
+    w = (n + 31) // 32
     path = jnp.zeros((capacity, n), jnp.int32)
-    mask = jnp.zeros(capacity, jnp.uint32).at[0].set(1)  # city 0 visited
+    mask = jnp.zeros((capacity, w), jnp.uint32).at[0, 0].set(1)  # city 0 visited
     depth = jnp.zeros(capacity, jnp.int32).at[0].set(1)
     cost = jnp.zeros(capacity, dtype)
     bound = jnp.zeros(capacity, dtype)
@@ -324,9 +376,11 @@ def solve(
     ``max_iters``/``time_limit_s``/``target_cost`` (then best-so-far).
     """
     n = d.shape[0]
-    if not 3 <= n <= 32:
-        # visited sets are uint32 bitmasks; 1-tree needs >= 3 vertices
-        raise ValueError(f"B&B engine supports 3 <= n <= 32 cities, got {n}")
+    if not 3 <= n <= MAX_BNB_CITIES:
+        # 4 uint32 mask words; 1-tree needs >= 3 vertices
+        raise ValueError(
+            f"B&B engine supports 3 <= n <= {MAX_BNB_CITIES} cities, got {n}"
+        )
     d32 = jnp.asarray(d, jnp.float32)
     min_out, bound_adj, root_lb = _bound_setup(d, bound)
     min_out_np = np.asarray(min_out, np.float64)
@@ -334,9 +388,7 @@ def solve(
     if resume_from:
         fr, inc_cost, inc_tour = restore(resume_from, expect_d=d, expect_bound=bound)
     else:
-        inc_tour_np = two_opt(
-            np.asarray(d, np.float64), nearest_neighbor_tour(np.asarray(d))
-        )
+        inc_tour_np = strong_incumbent(d)
         inc_cost = jnp.asarray(
             tour_cost(np.asarray(d, np.float64), inc_tour_np), jnp.float32
         )
@@ -413,23 +465,26 @@ def solve_sharded(
     from ..parallel.mesh import RANK_AXIS
 
     n = d.shape[0]
-    if not 3 <= n <= 32:
-        raise ValueError(f"B&B engine supports 3 <= n <= 32 cities, got {n}")
+    if not 3 <= n <= MAX_BNB_CITIES:
+        raise ValueError(
+            f"B&B engine supports 3 <= n <= {MAX_BNB_CITIES} cities, got {n}"
+        )
     num_ranks = int(mesh.devices.size)
     d32 = jnp.asarray(d, jnp.float32)
     d_np = np.asarray(d, np.float64)
     min_out, bound_adj, root_lb = _bound_setup(d, bound)
     min_out_np = np.asarray(min_out, np.float64)
 
-    inc_tour_np = two_opt(d_np, nearest_neighbor_tour(d_np))
+    inc_tour_np = strong_incumbent(d)
     inc_cost0 = tour_cost(d_np, inc_tour_np)
 
     # seed: depth-2 children of the root, round-robin over ranks
     sum_min0 = float(min_out_np[1:].sum())
     leaves = {f: [] for f in Frontier._fields}
+    n_words = (n + 31) // 32
     for r in range(num_ranks):
         path = np.zeros((capacity_per_rank, n), np.int32)
-        mask = np.zeros(capacity_per_rank, np.uint32)
+        mask = np.zeros((capacity_per_rank, n_words), np.uint32)
         depth = np.zeros(capacity_per_rank, np.int32)
         cost = np.zeros(capacity_per_rank, np.float32)
         bound = np.zeros(capacity_per_rank, np.float32)
@@ -438,7 +493,8 @@ def solve_sharded(
         for slot, c in enumerate(mine):
             path[slot, 0] = 0
             path[slot, 1] = c
-            mask[slot] = np.uint32(1 | (1 << c))
+            mask[slot, 0] = np.uint32(1)  # city 0
+            mask[slot, c // 32] |= np.uint32(1) << np.uint32(c % 32)
             depth[slot] = 2
             cost[slot] = d_np[0, c]
             bound[slot] = d_np[0, c] + sum_min0 + float(bound_adj[c])
@@ -563,6 +619,12 @@ def restore(
     """Load a checkpoint; refuses one written for a different instance or
     (the frontier's carried sums are bound-specific) a different bound."""
     z = np.load(_norm_ckpt_path(path))
+    if z["mask"].ndim != 2:
+        raise ValueError(
+            f"checkpoint {path!r} uses the pre-multi-word mask layout "
+            "([F] uint32); it cannot be resumed by this version — rerun "
+            "from scratch"
+        )
     if expect_d is not None and "d_fingerprint" in z:
         if not np.allclose(z["d_fingerprint"], _d_fingerprint(expect_d)):
             raise ValueError(

@@ -128,6 +128,7 @@ def run_pipeline_sharded(
         phase_seconds={"solve_reduce": time.perf_counter() - t0},
         dp_states=plan.dp_states * num_blocks,
         dp_transitions=plan.dp_transitions * num_blocks,
+        dist=dist,
     )
 
 
@@ -193,4 +194,5 @@ def run_pipeline_ranks(
         phase_seconds={"solve_reduce": time.perf_counter() - t0},
         dp_states=plan.dp_states * num_blocks,
         dp_transitions=plan.dp_transitions * num_blocks,
+        dist=dist,
     )

@@ -46,6 +46,9 @@ class PipelineResult:
     phase_seconds: Dict[str, float] = field(default_factory=dict)
     dp_states: int = 0
     dp_transitions: int = 0
+    #: resident [N, N] device distance matrix (reused by --improve; avoids
+    #: recomputing the most expensive host phase)
+    dist: Optional[jnp.ndarray] = None
 
 
 def block_distance_slices(dist: jnp.ndarray, num_blocks: int, n: int) -> jnp.ndarray:
@@ -123,4 +126,5 @@ def run_pipeline(
         phase_seconds=timer.seconds,
         dp_states=plan.dp_states * num_blocks,
         dp_transitions=plan.dp_transitions * num_blocks,
+        dist=dist,
     )

@@ -59,6 +59,13 @@ def build_parser() -> argparse.ArgumentParser:
         metavar="DIR",
         help="dump a jax.profiler trace (TensorBoard/Perfetto) to DIR",
     )
+    p.add_argument(
+        "--improve",
+        action="store_true",
+        help="polish the merged tour with device 2-opt and report its TRUE "
+        "re-measured cost (a deliberate deviation from the reference's "
+        "formulaic merge cost, SURVEY.md quirk #4)",
+    )
     return p
 
 
@@ -119,6 +126,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     n, nb = args.numCitiesPerBlock, args.numBlocks
     if args.backend == "native":
         # pure C++ host path (native/): no jax import, double precision only
+        if args.improve:
+            print(
+                "error: --improve needs a jax backend (not --backend=native)",
+                file=sys.stderr,
+            )
+            return 2
         if args.trace:
             print(
                 "error: --trace needs a jax backend (not --backend=native)",
@@ -195,6 +208,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+
+    if args.improve:
+        import jax.numpy as jnp
+
+        from ..parallel.seq_improve import improve_tour
+
+        mesh = None
+        if args.ranks > 1 and len(jax.devices()) >= args.ranks:
+            # real multi-device run: polish with the ring improver
+            from ..parallel.mesh import make_rank_mesh
+
+            mesh = make_rank_mesh(args.ranks)
+        order = jnp.asarray(res.tour_ids[:-1], jnp.int32)
+        _, true_len = improve_tour(order, res.dist.astype(dtype), mesh)
+        res.cost = float(true_len)
 
     _emit_result(
         args, backend=platform, dtype=dtype, cost=res.cost,
