@@ -111,3 +111,40 @@ def test_idle_ranks():
 def test_torus_mesh_runs():
     mesh = make_torus_mesh(jax.devices()[:4])
     assert mesh.devices.shape == (2, 2)
+
+
+def test_initialize_multihost_single_process():
+    """initialize_multihost joins a (1-process) jax.distributed cluster.
+
+    Run in a subprocess: jax.distributed must initialize before any backend,
+    and this test process already has one.
+    """
+    import socket
+    import subprocess
+    import sys
+
+    with socket.socket() as s:  # ephemeral free port; no cross-run collision
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    code = (
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from jax._src import xla_bridge as xb\n"
+        "xb._backend_factories.pop('axon', None)\n"
+        "from tsp_mpi_reduction_tpu.parallel.mesh import initialize_multihost\n"
+        f"n = initialize_multihost('localhost:{port}', 1, 0)\n"
+        "assert n >= 1, n\n"
+        f"n2 = initialize_multihost('localhost:{port}', 1, 0)  # idempotent\n"
+        "assert n2 == n\n"
+        "print('multihost-ok', n)\n"
+    )
+    import pathlib
+
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        cwd=str(pathlib.Path(__file__).resolve().parent.parent),
+    )
+    assert "multihost-ok" in out.stdout, (out.stdout, out.stderr)

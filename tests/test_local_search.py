@@ -124,12 +124,72 @@ def test_strong_incumbent_beats_or_matches_single_start():
     assert tour_cost(d, multi) <= tour_cost(d, single) + 1e-9
 
 
-def test_cli_improve_reports_true_cost(capsys):
+def test_cli_improve_reports_true_cost_of_polished_tour(capsys):
+    """--improve's printed cost must equal the true length of the polished
+    tour, which improve_tour guarantees is <= the true length of the input
+    tour. (The unflagged run's formulaic merge cost is NOT comparable —
+    SURVEY.md quirk #4 — so no ordering vs it is asserted.)"""
+    from tsp_mpi_reduction_tpu.models.pipeline import run_pipeline
     from tsp_mpi_reduction_tpu.utils.cli import main
 
-    code = main(["5", "8", "400", "400", "--backend=cpu"])
-    base = float(capsys.readouterr().out.strip().split()[-1])
-    code2 = main(["5", "8", "400", "400", "--backend=cpu", "--improve"])
+    code = main(["5", "8", "400", "400", "--backend=cpu", "--improve"])
     improved = float(capsys.readouterr().out.strip().split()[-1])
-    assert code == code2 == 0
-    assert improved <= base + 1e-9
+    assert code == 0
+    res = run_pipeline(5, 8, 400, 400)
+    true_base = float(
+        tour_length(jnp.asarray(res.tour_ids[:-1], jnp.int32), res.dist)
+    )
+    assert improved <= true_base + 1e-6
+
+
+def test_or_opt_sweep_improves_and_preserves_permutation():
+    from tsp_mpi_reduction_tpu.ops.local_search import or_opt_sweep
+
+    for n, seed in [(14, 10), (40, 11)]:
+        d = _metric(n, seed)
+        dj = jnp.asarray(d)
+        t0 = jnp.asarray(np.random.default_rng(seed).permutation(n), jnp.int32)
+        t1, delta = or_opt_sweep(t0, dj)
+        assert sorted(np.asarray(t1).tolist()) == list(range(n))
+        assert float(tour_length(t1, dj)) == pytest.approx(
+            float(tour_length(t0, dj)) + float(delta), rel=1e-6
+        )
+        assert float(delta) <= 1e-6
+
+
+def test_or_opt_delta_matches_brute_force_relocation():
+    """Every finite (L, i, j) delta equals the measured cost change."""
+    from tsp_mpi_reduction_tpu.ops.local_search import (
+        _apply_relocation,
+        _relocation_deltas,
+    )
+
+    n = 9
+    d = _metric(n, 12)
+    dj = jnp.asarray(d)
+    t = jnp.asarray(np.random.default_rng(12).permutation(n), jnp.int32)
+    base = float(tour_length(t, dj))
+    for L in (1, 2, 3):
+        deltas = np.asarray(_relocation_deltas(t, dj, L))
+        for i in range(n):
+            for j in range(n):
+                if not np.isfinite(deltas[i, j]):
+                    continue
+                moved = _apply_relocation(t, i, L, j)
+                assert sorted(np.asarray(moved).tolist()) == list(range(n)), (
+                    L, i, j,
+                )
+                got = float(tour_length(moved, dj)) - base
+                assert got == pytest.approx(deltas[i, j], abs=1e-6), (L, i, j)
+
+
+def test_polish_at_least_as_good_as_two_opt():
+    from tsp_mpi_reduction_tpu.ops.local_search import polish
+
+    d = _metric(48, 13)
+    dj = jnp.asarray(d)
+    t0 = jnp.asarray(np.random.default_rng(13).permutation(48), jnp.int32)
+    t2, _ = two_opt_sweep(t0, dj)
+    tp, _ = polish(t0, dj)
+    assert sorted(np.asarray(tp).tolist()) == list(range(48))
+    assert float(tour_length(tp, dj)) <= float(tour_length(t2, dj)) + 1e-6

@@ -93,20 +93,21 @@ def nearest_neighbor_tour(d: np.ndarray, start: int = 0) -> np.ndarray:
 
 def strong_incumbent(d: np.ndarray, starts: int = 8) -> np.ndarray:
     """Best of ``starts`` nearest-neighbor tours, each polished by the
-    device 2-opt kernel in one vmapped batch (ops.local_search).
+    device 2-opt + Or-opt kernels in one vmapped batch (ops.local_search).
 
     Returns a closed [n+1] tour rotated to start at city 0. Costs are
     re-measured on host in float64, so the incumbent fed to the pruner is
     a true tour cost regardless of the f32 polish.
     """
-    from ..ops.local_search import two_opt_batch
+    from ..ops.local_search import polish
 
     n = d.shape[0]
     d64 = np.asarray(d, np.float64)
     ss = sorted(set(np.linspace(0, n - 1, min(starts, n)).astype(int).tolist()))
     opens = np.stack([nearest_neighbor_tour(d64, s)[:-1] for s in ss])
-    polished, _ = two_opt_batch(
-        jnp.asarray(opens, jnp.int32), jnp.asarray(d, jnp.float32)
+    d32 = jnp.asarray(d, jnp.float32)
+    polished, _ = jax.vmap(lambda t: polish(t, d32))(
+        jnp.asarray(opens, jnp.int32)
     )
     polished = np.asarray(polished)
     costs = [tour_cost(d64, np.concatenate([t, t[:1]])) for t in polished]

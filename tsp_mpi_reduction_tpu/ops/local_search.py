@@ -63,6 +63,7 @@ def two_opt_sweep(
     """
     n = t.shape[0]
     ar = jnp.arange(n)
+    thr = _improve_threshold(d)
 
     def cond(carry):
         _, go, it, _ = carry
@@ -74,7 +75,7 @@ def two_opt_sweep(
         flat = jnp.argmin(delta.reshape(-1))
         i, j = flat // n, flat % n
         dbest = delta.reshape(-1)[flat]
-        improve = dbest < -1e-6
+        improve = dbest < thr
         # reverse t[i+1..j] via an index remap (identity when not improving)
         in_seg = (ar >= i + 1) & (ar <= j)
         src = jnp.where(in_seg & improve, j - ar + i + 1, ar)
@@ -82,6 +83,129 @@ def two_opt_sweep(
 
     # derive the initial carries from ``t`` so their varying-axis type
     # matches the body outputs under shard_map (see shard_map vma docs)
+    zero = t[0] * 0
+    t, _, _, acc = jax.lax.while_loop(
+        cond, body, (t, zero == 0, zero, zero.astype(d.dtype))
+    )
+    return t, acc
+
+
+def _improve_threshold(d: jnp.ndarray) -> jnp.ndarray:
+    """Accept-move threshold scaled to the distance magnitude.
+
+    Delta entries are f32/f64 sums of four ``d`` entries, so their rounding
+    noise scales with ``max(d)``; a fixed absolute epsilon would let noise
+    moves churn (and break the sweeps' monotone-termination property) on
+    large-coordinate instances. Improvements below ~32 ulp of the largest
+    edge are noise-level and skipped.
+    """
+    finite = jnp.where(jnp.isfinite(d), d, 0.0)
+    return -(32.0 * jnp.finfo(d.dtype).eps * jnp.max(finite) + 1e-9)
+
+
+def _relocation_deltas(t: jnp.ndarray, d: jnp.ndarray, L: int) -> jnp.ndarray:
+    """Delta of moving the length-``L`` segment at position i to after
+    position j, for every (i, j) on a closed tour. Invalid pairs are +inf.
+
+    Segments may not wrap the linear layout (i + L <= n); the insertion
+    edge may be the closing edge (j = n-1). delta = (bridge the gap left
+    behind) + (splice into edge j) - (removed edges).
+    """
+    n = t.shape[0]
+    ar = jnp.arange(n)
+    pred = t[(ar - 1) % n]  # t[i-1]
+    seg_end = t[(ar + L - 1) % n]
+    succ = t[(ar + L) % n]
+    jnxt = t[(ar + 1) % n]
+    remove = d[pred, succ] - d[pred, t] - d[seg_end, succ]  # [i]
+    splice = (
+        d[t[None, :], t[:, None]]  # d[t[j], t[i]] at [i, j]
+        + d[seg_end[:, None], jnxt[None, :]]
+        - d[t, jnxt][None, :]
+    )
+    delta = remove[:, None] + splice
+    i_ = ar[:, None]
+    j_ = ar[None, :]
+    # j may not touch the segment or its predecessor edge (identity/overlap)
+    valid = ((j_ - (i_ - 1)) % n > L) & (i_ + L <= n)
+    return jnp.where(valid, delta, INF)
+
+
+def _apply_relocation(t: jnp.ndarray, i, L: int, j) -> jnp.ndarray:
+    """Move segment t[i:i+L] to sit after position j (linear layout)."""
+    ar = jnp.arange(t.shape[0])
+    # forward (j >= i+L): the gap closes leftward, block lands at j-L+1..j
+    src_f = jnp.where((ar >= i) & (ar <= j - L), ar + L, ar)
+    src_f = jnp.where((ar >= j - L + 1) & (ar <= j), i + (ar - (j - L + 1)), src_f)
+    # backward (j <= i-2): block lands at j+1..j+L, the gap closes rightward
+    src_b = jnp.where((ar >= j + 1) & (ar <= j + L), i + (ar - j - 1), ar)
+    src_b = jnp.where((ar >= j + L + 1) & (ar <= i + L - 1), ar - L, src_b)
+    return t[jnp.where(j >= i, src_f, src_b)]
+
+
+@partial(jax.jit, static_argnames=("max_iters",))
+def or_opt_sweep(
+    t: jnp.ndarray, d: jnp.ndarray, max_iters: int = 256
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Best-improvement Or-opt (relocate segments of length 1-3) on a
+    closed tour until converged -> (tour', total_delta).
+
+    Complements 2-opt: relocation moves are not reachable by reversals, so
+    alternating the two sweeps (:func:`polish`) escapes each other's local
+    optima.
+    """
+    n = t.shape[0]
+    lengths = (1, 2, 3)
+    thr = _improve_threshold(d)
+
+    def cond(carry):
+        _, go, it, _ = carry
+        return go & (it < max_iters)
+
+    def body(carry):
+        t, _, it, acc = carry
+        deltas = jnp.stack([_relocation_deltas(t, d, L) for L in lengths])
+        flat = jnp.argmin(deltas.reshape(-1))
+        dbest = deltas.reshape(-1)[flat]
+        li = flat // (n * n)
+        i = (flat // n) % n
+        j = flat % n
+        improve = dbest < thr
+        cands = [_apply_relocation(t, i, L, j) for L in lengths]
+        moved = jnp.select([li == x for x in range(len(lengths))], cands, t)
+        t = jnp.where(improve, moved, t)
+        return t, improve, it + 1, acc + jnp.where(improve, dbest, 0.0)
+
+    zero = t[0] * 0
+    t, _, _, acc = jax.lax.while_loop(
+        cond, body, (t, zero == 0, zero, zero.astype(d.dtype))
+    )
+    return t, acc
+
+
+@partial(jax.jit, static_argnames=("max_rounds",))
+def polish(
+    t: jnp.ndarray, d: jnp.ndarray, max_rounds: int = 8
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Alternate 2-opt and Or-opt sweeps until neither improves.
+
+    Returns (tour', total_delta). Each constituent sweep is monotone, so
+    the combined loop is monotone and terminates.
+    """
+
+    def cond(carry):
+        _, go, r, _ = carry
+        return go & (r < max_rounds)
+
+    def body(carry):
+        t, _, r, acc = carry
+        t, d1 = two_opt_sweep(t, d, closed=True)
+        t, d2 = or_opt_sweep(t, d)
+        # each applied move cleared the scaled threshold, so any progress
+        # at all shows up as a strictly negative sum (exact 0.0 otherwise)
+        improved = (d1 + d2) < 0
+        return t, improved, r + 1, acc + d1 + d2
+
     zero = t[0] * 0
     t, _, _, acc = jax.lax.while_loop(
         cond, body, (t, zero == 0, zero, zero.astype(d.dtype))

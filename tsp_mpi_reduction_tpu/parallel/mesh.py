@@ -38,6 +38,45 @@ def make_torus_mesh(
     return jax.sharding.Mesh(arr, axis_names)
 
 
+def initialize_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> int:
+    """Join a multi-host run (``jax.distributed``) — the DCN analog of
+    ``mpirun`` launching ranks on several nodes.
+
+    The reference scales across nodes by letting ``mpirun`` place ranks
+    anywhere and routing every message through MPI (SURVEY.md §2). The JAX
+    equivalent is one controller process per host: after this call,
+    ``jax.devices()`` spans every host's chips, meshes built by
+    :func:`make_rank_mesh`/:func:`make_torus_mesh` cover the whole slice,
+    and XLA routes collectives over ICI within a pod and DCN between pods —
+    no application-code changes, the same ``shard_map`` programs run.
+
+    All three arguments default to the standard cluster environment
+    (``JAX_COORDINATOR_ADDRESS`` etc. / TPU pod metadata), so on Cloud TPU
+    pods a bare ``initialize_multihost()`` suffices. Returns the global
+    device count. No-op (returning the current count) when jax.distributed
+    is already initialized.
+    """
+    is_init = getattr(jax.distributed, "is_initialized", None)
+    if is_init is not None:
+        if is_init():
+            return len(jax.devices())  # already joined
+    else:  # older jax without the public predicate
+        from jax._src import distributed as _dist
+
+        if getattr(_dist.global_state, "client", None) is not None:
+            return len(jax.devices())
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return len(jax.devices())
+
+
 def make_rank_mesh(
     num_devices: Optional[int] = None,
     devices: Optional[Sequence[jax.Device]] = None,

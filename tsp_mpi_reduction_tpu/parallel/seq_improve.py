@@ -80,10 +80,11 @@ def ring_two_opt(
             out_specs=P(RANK_AXIS),
         )
     )(sharded, d)
-    # one final closed sweep on the assembled tour catches any remaining
-    # cross-boundary move (single-device; cheap relative to the ring phase)
-    flat = out.reshape(-1)
-    improved, _ = two_opt_sweep(flat, d, closed=True, max_iters=max_iters_per_sweep)
+    # one final closed polish (2-opt + Or-opt) on the assembled tour catches
+    # any remaining cross-boundary move (single-device; cheap vs the ring phase)
+    from ..ops.local_search import polish
+
+    improved, _ = polish(out.reshape(-1), d)
     return improved
 
 
@@ -96,7 +97,7 @@ def improve_tour(
     unlike the reference's formulaic merge cost (SURVEY.md quirk #4), this
     is the true cost of the returned tour.
     """
-    from ..ops.local_search import tour_length
+    from ..ops.local_search import polish, tour_length
 
     if mesh is not None and int(mesh.devices.size) > 1 and (
         tour.shape[0] % int(mesh.devices.size) == 0
@@ -104,5 +105,5 @@ def improve_tour(
     ):
         order = ring_two_opt(tour, d, mesh)
     else:
-        order, _ = two_opt_sweep(tour, d, closed=True)
+        order, _ = polish(tour, d)
     return order, tour_length(order, d, closed=True)

@@ -28,6 +28,8 @@ from __future__ import annotations
 
 import argparse
 import sys
+
+import numpy as np
 import time
 from typing import List, Optional
 
@@ -221,7 +223,9 @@ def main(argv: Optional[List[str]] = None) -> int:
 
             mesh = make_rank_mesh(args.ranks)
         order = jnp.asarray(res.tour_ids[:-1], jnp.int32)
-        _, true_len = improve_tour(order, res.dist.astype(dtype), mesh)
+        new_order, true_len = improve_tour(order, res.dist.astype(dtype), mesh)
+        new_open = np.asarray(new_order)
+        res.tour_ids = np.concatenate([new_open, new_open[:1]])  # keep closed
         res.cost = float(true_len)
 
     _emit_result(
