@@ -73,13 +73,21 @@ def main() -> int:
 
         select_backend("cpu")
 
+    import os
+
     import jax
     import jax.numpy as jnp
 
+    from tsp_mpi_reduction_tpu.ops import held_karp
     from tsp_mpi_reduction_tpu.ops.distance import distance_matrix
     from tsp_mpi_reduction_tpu.ops.generator import generate_instance
     from tsp_mpi_reduction_tpu.ops.held_karp import build_plan, solve_blocks_from_dists
     from tsp_mpi_reduction_tpu.ops.merge import fold_tours
+
+    impl = os.environ.get("TSP_TPU_IMPL")  # compact|dense|fused|pallas
+    if impl:
+        held_karp.set_impl(impl)
+        print(f"bench impl override: {impl}", file=sys.stderr)
 
     dev = jax.devices()[0]
     print(f"bench device: {dev}", file=sys.stderr)

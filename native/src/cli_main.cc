@@ -1,0 +1,77 @@
+// tsp-native — standalone CLI over the native runtime (no Python, no JAX).
+//
+// Drop-in for the reference binary's contract (tsp.cpp:270-368): same four
+// positional args (tsp.cpp:282), same usage line / exit 1 on wrong arity
+// (tsp.cpp:280-284), same >16-cities scold + exit(1337) (tsp.cpp:289-295),
+// same banner/dims lines and machine-parsed final line (tsp.cpp:307,377,363).
+// Optional 5th/6th args extend it: ranks (emulated merge-tree shape) and
+// seed. Deviations match the framework: n < 3 errors cleanly (SURVEY.md
+// quirk #6) instead of hanging or emitting the INT_MAX sentinel.
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+#include <vector>
+
+#include "tsp_native.h"
+
+static unsigned long long now_ms() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC_RAW, &ts);
+  return (unsigned long long)ts.tv_sec * 1000ull +
+         (unsigned long long)(ts.tv_nsec / 1000000);
+}
+
+int main(int argc, char** argv) {
+  unsigned long long start = now_ms();
+  if (argc < 5 || argc > 7) {
+    printf(
+        "Usage is: tsp numCitiesPerBlock numBlocks gridDimX gridDimY "
+        "[ranks] [seed]\n");
+    return 1;
+  }
+  int n = atoi(argv[1]);
+  int nb = atoi(argv[2]);
+  int gx = atoi(argv[3]);
+  int gy = atoi(argv[4]);
+  int ranks = argc > 5 ? atoi(argv[5]) : 1;
+  unsigned seed = argc > 6 ? (unsigned)strtoul(argv[6], nullptr, 10) : 0u;
+
+  if (n > 16) {
+    printf(
+        "You probably don't want to go above 16 cities per block..."
+        " it'll take forever\n");
+    exit(1337);
+  }
+  if (n < 3) {
+    fprintf(stderr,
+            "error: blocks need >= 3 cities (got %d): the reference yields "
+            "an INT_MAX sentinel for 1 and hangs for 2 (SURVEY.md quirk #6)\n",
+            n);
+    return 2;
+  }
+  if (nb < 1 || gx < 1 || gy < 1 || ranks < 1) {
+    fprintf(stderr, "error: numBlocks/gridDims/ranks must be positive\n");
+    return 2;
+  }
+
+  printf("We have %d cities for each of our %d blocks\n", n, nb);
+  int32_t rows = 0, cols = 0;
+  tsp_blocks_per_dim(nb, &rows, &cols);
+  printf("%d blocks in X %d in Y\n", rows, cols);
+
+  double cost = 0.0;
+  std::vector<int32_t> tour((size_t)nb * n + 1);
+  int32_t tour_len = 0;
+  int rc = tsp_run_pipeline(n, nb, gx, gy, seed, ranks, &cost, tour.data(),
+                            &tour_len, nullptr);
+  if (rc != 0) {
+    fprintf(stderr, "error: pipeline failed (rc=%d)\n", rc);
+    return 2;
+  }
+  // the reference's machine-parsed report line (tsp.cpp:363)
+  printf("TSP ran in %llu ms for %lu cities and the trip cost %f\n",
+         now_ms() - start, (unsigned long)((long)nb * n), cost);
+  return 0;
+}

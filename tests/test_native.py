@@ -118,3 +118,37 @@ def test_merge_matches_jax_operator():
     merged = merge_tours(p1, p2, jnp.asarray(dist))
     assert float(merged.cost) == n_cost
     assert np.asarray(merged.ids)[: int(merged.length)].tolist() == n_ids.tolist()
+
+
+def test_native_cli_binary_reference_contract(tmp_path):
+    """The standalone tsp-native binary honors the reference's argv/stdout
+    contract and is bit-exact with the oracle cost."""
+    import pathlib
+    import subprocess
+
+    root = pathlib.Path(__file__).resolve().parent.parent
+    subprocess.run(
+        ["make", "-C", str(root / "native"), "tsp-native"],
+        check=True,
+        capture_output=True,
+    )
+    binary = str(root / "native" / "tsp-native")
+
+    r = subprocess.run(
+        [binary, "10", "6", "500", "500"], capture_output=True, text=True
+    )
+    assert r.returncode == 0
+    lines = r.stdout.strip().split("\n")
+    assert lines[0] == "We have 10 cities for each of our 6 blocks"
+    assert lines[1] == "2 blocks in X 3 in Y"
+    assert lines[-1].endswith("the trip cost 3720.557435")
+
+    r = subprocess.run([binary, "17", "1", "10", "10"], capture_output=True)
+    assert r.returncode == 57  # exit(1337) & 0xFF, like the reference
+
+    r = subprocess.run([binary], capture_output=True, text=True)
+    assert r.returncode == 1
+    assert r.stdout.startswith("Usage is:")
+
+    r = subprocess.run([binary, "2", "4", "10", "10"], capture_output=True)
+    assert r.returncode == 2  # clean error instead of the reference hang
