@@ -26,9 +26,10 @@ static unsigned long long now_ms() {
 int main(int argc, char** argv) {
   unsigned long long start = now_ms();
   if (argc < 5 || argc > 7) {
-    printf(
-        "Usage is: tsp numCitiesPerBlock numBlocks gridDimX gridDimY "
-        "[ranks] [seed]\n");
+    // byte-identical to the reference's usage line (tsp.cpp:282); the
+    // optional [ranks] [seed] extensions are documented on stderr only
+    printf("Usage:  ./tsp numCitiesPerBlock numBlocks gridDimX gridDimY\n");
+    fprintf(stderr, "(tsp-native also accepts optional [ranks] [seed])\n");
     return 1;
   }
   int n = atoi(argv[1]);
@@ -39,9 +40,10 @@ int main(int argc, char** argv) {
   unsigned seed = argc > 6 ? (unsigned)strtoul(argv[6], nullptr, 10) : 0u;
 
   if (n > 16) {
+    // byte-identical to the reference's scold (tsp.cpp:292) + exit(1337)
     printf(
-        "You probably don't want to go above 16 cities per block..."
-        " it'll take forever\n");
+        "Come on... We don't want to wait forever so lets just have you "
+        "retry that with less than 16 cities per block...\n");
     exit(1337);
   }
   if (n < 3) {

@@ -26,6 +26,52 @@ def test_matches_held_karp_random():
         assert sorted(res.tour[:-1].tolist()) == list(range(12))
 
 
+def test_matches_held_karp_integer_metric():
+    """Integral metrics take the fixed-point-exact path with ceil-aware
+    pruning (prune at bound > inc - 1); optimality must be preserved."""
+    for seed in (0, 1, 2):
+        d = np.rint(random_d(12, seed) * 10)
+        hk, _ = solve_blocks_from_dists(d[None])
+        for mst in (True, False):
+            res = bb.solve(d, capacity=1 << 14, k=64, mst_prune=mst)
+            assert res.proven_optimal
+            assert res.cost == float(np.rint(hk[0])) == float(hk[0])
+            assert res.root_lower_bound <= res.cost
+            assert res.root_lower_bound == int(res.root_lower_bound)
+
+
+def test_integer_metric_min_out_matches():
+    """Weak-bound (min-out) mode on an integer metric: the search — not the
+    incumbent heuristic — must prove the optimum (fixed-point ceil pruning
+    with pi = 0)."""
+    d = np.rint(random_d(11, 7) * 10)
+    hk, _ = solve_blocks_from_dists(d[None])
+    res = bb.solve(d, capacity=1 << 14, k=64, bound="min-out")
+    assert res.proven_optimal and res.cost == float(hk[0])
+
+
+def test_float_slack_large_scale():
+    """Float metrics get a worst-case f32 rounding slack (ADVICE r1 medium):
+    with distances at scale ~1e6, where naive f32 bounds would overshoot,
+    optimality vs the f64 Held-Karp oracle must still hold."""
+    d = random_d(12, 9) * 1e4  # coords ~1e6-scale distances after *1e4
+    hk, _ = solve_blocks_from_dists(d[None])
+    res = bb.solve(d, capacity=1 << 14, k=64)
+    assert res.proven_optimal
+    assert abs(res.cost - float(hk[0])) < 1e-2 * 1e4
+
+
+def test_mst_bound_node_efficiency():
+    """The per-node MST re-bound must expand far fewer nodes than the
+    incremental bound alone on the same instance."""
+    d = np.rint(random_d(13, 11) * 10)
+    weak = bb.solve(d, capacity=1 << 15, k=64, mst_prune=False)
+    strong = bb.solve(d, capacity=1 << 15, k=64, mst_prune=True)
+    assert weak.proven_optimal and strong.proven_optimal
+    assert weak.cost == strong.cost
+    assert strong.nodes_expanded <= weak.nodes_expanded
+
+
 @pytest.mark.slow
 def test_burma14_proven_optimal():
     d = burma14().distance_matrix()

@@ -8,7 +8,6 @@ import pytest
 
 from tsp_mpi_reduction_tpu.models import branch_bound as bb
 from tsp_mpi_reduction_tpu.ops.one_tree import (
-    bound_arrays,
     held_karp_potentials,
     mst_cost_degrees,
     one_tree_cost_degrees,
@@ -78,13 +77,17 @@ def test_potentials_tighten_but_stay_valid(n, seed):
     assert float(lb) >= float(plain) - 1e-9  # at least the pi=0 value
 
 
-def test_bound_arrays_zero_pi_reduces_to_min_out():
+def test_bound_setup_zero_pi_reduces_to_min_out():
     d = _random_metric(6, 7)
-    dj = jnp.asarray(d, jnp.float64)
-    w, adj = bound_arrays(dj, jnp.zeros(6, jnp.float64))
+    bd = bb._bound_setup(d, "min-out")
     min_out = np.where(np.eye(6, dtype=bool), np.inf, d).min(1)
-    np.testing.assert_allclose(np.asarray(w), min_out, rtol=1e-12)
-    np.testing.assert_allclose(np.asarray(adj), np.zeros(6), atol=0)
+    np.testing.assert_allclose(np.asarray(bd.min_out), min_out, rtol=1e-6)
+    # float path: the rounding slack is shaved off the (otherwise zero) adj
+    np.testing.assert_allclose(
+        np.asarray(bd.bound_adj), -float(bd.slack) * np.ones(6), rtol=1e-6
+    )
+    assert not bd.integral  # random float metric takes the slack path
+    assert float(bd.slack) > 0.0
 
 
 def test_burma14_one_tree_bound_is_tight():

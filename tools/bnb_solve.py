@@ -22,7 +22,11 @@ from tsp_mpi_reduction_tpu.utils.backend import select_backend  # noqa: E402
 
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("instance", help="TSPLIB .tsp path or 'burma14'")
+    ap.add_argument(
+        "instance",
+        help="TSPLIB .tsp path or an embedded instance name "
+        "(burma14, ulysses16, ulysses22, eil51, berlin52, kroA100)",
+    )
     ap.add_argument("--backend", default="auto", choices=["auto", "cpu", "tpu"])
     ap.add_argument("--ranks", type=int, default=1)
     ap.add_argument("--k", type=int, default=256)
@@ -48,8 +52,8 @@ def main() -> int:
     from tsp_mpi_reduction_tpu.models import branch_bound as bb
     from tsp_mpi_reduction_tpu.utils import tsplib
 
-    if args.instance == "burma14":
-        inst = tsplib.burma14()
+    if args.instance in tsplib.EMBEDDED:
+        inst = tsplib.embedded(args.instance)
     else:
         try:
             inst = tsplib.load(args.instance)

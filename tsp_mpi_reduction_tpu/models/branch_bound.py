@@ -166,45 +166,190 @@ def _mask_consts(n: int):
     )
 
 
-def _bound_setup(d, bound: str):
-    """Per-city weights + per-child adjustment + root LB for a bound mode.
+class BoundData(NamedTuple):
+    """Device arrays + flags driving the expansion kernel's pruning."""
 
-    "min-out": weights = cheapest outgoing edge, adjustment = 0.
-    "one-tree": Held-Karp potentials (ops.one_tree) reshape the metric —
-    weights = min reduced outgoing edge - 2*pi, adjustment = pi - pi[0] —
-    which typically prunes orders of magnitude harder at identical kernel
-    cost. Both return float32 device arrays for the expansion kernel.
+    min_out: jnp.ndarray  # [n] f32 per-city weight (incremental bound)
+    bound_adj: jnp.ndarray  # [n] f32 per-child adjustment
+    dbar: jnp.ndarray  # [n, n] f32 reduced metric d + pi_i + pi_j (MST bound)
+    pi: jnp.ndarray  # [n] f32 potentials (zeros in min-out mode)
+    slack: jnp.ndarray  # scalar f32 rounding slack for the MST bound (0 if exact)
+    root_lb: float  # certified global lower bound (f64-evaluated)
+    integral: bool  # metric is integer-valued; bounds are fixed-point exact
+
+
+def _bound_setup(d, bound: str, ascent_steps: int = 400) -> BoundData:
+    """Build the bound machinery for a metric + bound mode -> ``BoundData``.
+
+    "min-out": pi = 0 — weights are the plain cheapest outgoing edge.
+    "one-tree": Held-Karp subgradient ascent (ops.one_tree) supplies
+    potentials pi; weights become the min reduced outgoing edge - 2*pi with
+    a per-child adjustment pi[child] - pi[0]. The same pi also defines the
+    reduced metric ``dbar`` for the strong per-node MST bound
+    (_batched_mst_bound). The Held-Karp bound is valid for ARBITRARY pi, so
+    pi may be quantized freely; only the f64 re-evaluation of the root
+    bound must be (and is) certified.
+
+    Float32 safety is handled in one of two ways:
+
+    - **Integral metric** (all distances integers — every TSPLIB instance):
+      pi is snapped onto a power-of-two grid chosen so every intermediate
+      value in the expansion kernel is an exact multiple of the grid below
+      2^24 grid units — f32 fixed-point arithmetic with ZERO rounding
+      error, so bounds certify pruning with no slack. ``root_lb`` is the
+      certified f64 1-tree value raised to the next integer (the optimum is
+      an integer).
+    - **Float metric**: a slack sized to the worst-case accumulated f32
+      rounding of a root-to-leaf bound chain (~3n operations — prefix-cost
+      accumulation, carried weight sums, MST edges, pi corrections — on
+      values up to the magnitude cap, each contributing <= spacing(mag)/2)
+      is shaved off the per-child adjustment and subtracted whole from the
+      per-node MST bound, so rounding can never prune the true optimum.
+      Applied in BOTH bound modes.
     """
     n = d.shape[0]
     d64 = np.asarray(d, np.float64)
+    integral = bool(np.all(d64 == np.rint(d64)))
     eye = np.eye(n, dtype=bool)
-    if bound == "min-out":
-        w = np.where(eye, np.inf, d64).min(1)
-        adj = np.zeros(n)
-        root_lb = float(w.sum())  # every city is left once
-    elif bound == "one-tree":
-        from ..ops.one_tree import bound_arrays, held_karp_potentials
+    if bound == "one-tree":
+        from ..ops.one_tree import held_karp_potentials
 
         d32 = jnp.asarray(d64, jnp.float32)
-        pi, lb = held_karp_potentials(d32, steps=150)
-        w_j, adj_j = bound_arrays(d32, pi)
-        w = np.asarray(w_j, np.float64)
-        adj = np.asarray(adj_j, np.float64)
-        # float32 safety slack: node bounds are f32 sums of ~n weight terms,
-        # so shave n ulps off the per-child adjustment — rounding must never
-        # push a bound past the incumbent and prune the true optimum. The
-        # reported root bound gets the same shave so it stays a true lower
-        # bound despite the f32 ascent.
-        scale = float(np.abs(w).max()) + float(np.abs(adj).max()) + 1.0
-        slack = n * float(np.spacing(np.float32(scale)))
-        root_lb = float(lb) - slack
-        adj = adj - slack
+        pi_dev, _ = held_karp_potentials(d32, steps=ascent_steps)
+        pi64 = np.asarray(pi_dev, np.float64)
+    elif bound == "min-out":
+        pi64 = np.zeros(n)
     else:
         raise ValueError(f"bound must be 'one-tree' or 'min-out', got {bound!r}")
-    return jnp.asarray(w, jnp.float32), jnp.asarray(adj, jnp.float32), root_lb
+
+    # magnitude cap over every kernel intermediate: prefix costs (<= n*max d),
+    # MST sums over the reduced metric, carried weight sums, pi corrections
+    max_d = float(np.abs(d64).max())
+    max_pi = float(np.abs(pi64).max())
+    mag = n * (max_d + 4.0 * max_pi) + 4.0 * float(np.abs(pi64).sum()) + 1.0
+
+    # a negative grid exponent would make the grid coarser than 1, so integer
+    # distances would no longer be exact grid multiples — fall back to the
+    # slack path (only reachable for distances ~> 2^24/n, far beyond TSPLIB)
+    g_cap = int(np.floor(np.log2(2.0**24 / mag)))
+    if integral and g_cap < 0:
+        integral = False
+    if integral:
+        # finest power-of-two grid keeping all values exact in f32 (cap 2^-10)
+        grid = 2.0 ** (-min(10, g_cap))
+        pi64 = np.round(pi64 / grid) * grid
+        slack = 0.0
+    else:
+        slack = 3.0 * n * float(np.spacing(np.float32(mag)))
+
+    # derive everything from the (possibly quantized) pi in f64: for the
+    # integral path all results are exact grid multiples, hence exact in f32
+    dbar64 = d64 + pi64[:, None] + pi64[None, :]
+    dbar_inf = np.where(eye, np.inf, dbar64)
+    w = dbar_inf.min(1) - 2.0 * pi64
+    adj = pi64 - pi64[0]
+
+    if bound == "one-tree":
+        from ..ops.one_tree import one_tree_value_np
+
+        root_lb = one_tree_value_np(d64, pi64)
+    else:
+        root_lb = float(w.sum())  # every city is left once
+
+    if integral:
+        root_lb = float(np.ceil(root_lb - 1e-6))
+    else:
+        root_lb = root_lb - slack
+        adj = adj - slack
+    return BoundData(
+        jnp.asarray(w, jnp.float32),
+        jnp.asarray(adj, jnp.float32),
+        jnp.asarray(dbar64, jnp.float32),
+        jnp.asarray(pi64, jnp.float32),
+        jnp.asarray(slack, jnp.float32),
+        root_lb,
+        integral,
+    )
 
 
-@partial(jax.jit, static_argnames=("k", "n"))
+def _batched_mst_bound(
+    dbar: jnp.ndarray,
+    pi: jnp.ndarray,
+    unvis: jnp.ndarray,
+    cur: jnp.ndarray,
+    p_cost: jnp.ndarray,
+    n: int,
+):
+    """Reduced-cost MST + connection-edges lower bound for a batch of nodes.
+
+    For a node with prefix ending at ``cur`` and unvisited set U, the
+    remaining tour is a path cur -> (all of U) -> 0. Such a path uses
+    exactly one edge from cur into U, a spanning path of U (>= its MST),
+    and one edge from U to 0 — it can never take a direct cur->0 edge, so
+
+        MST_dbar(U) + min_u dbar[cur, u] + min_u dbar[0, u]
+
+    lower-bounds its reduced cost; for ``cur == 0`` (the root) the two
+    connection edges become the two cheapest 0-incident edges, making this
+    exactly the Held-Karp 1-tree. In the reduced metric
+    ``dbar = d + pi_i + pi_j`` the path's d-cost is its dbar-cost minus
+    ``pi[cur] + pi[0] + 2*sum(pi[U])``, giving the final bound
+
+        prefix_cost + MST_dbar(U) + conn - pi[cur] - pi[0] - 2*sum(pi[U]).
+
+    This is typically FAR stronger than the incremental min-out sum, at the
+    cost of a vmapped dense Prim (n-1 fori steps over [k, n] lanes — tiny
+    per-step work that pipelines fine under the inner while_loop). With
+    quantized pi (_bound_setup) every value is fixed-point-exact in f32, so
+    the bound certifies pruning with no slack.
+    """
+    big = jnp.asarray(jnp.inf, dbar.dtype)
+    k = unvis.shape[0]
+    lanes = jnp.arange(k)
+
+    # Prim over U, rooted at each lane's first unvisited vertex
+    start = jnp.argmax(unvis, axis=1)  # first True (garbage if U empty; masked)
+    init_intree = jnp.zeros((k, n), bool).at[lanes, start].set(True)
+    init_mind = jnp.where(unvis, dbar[start], big)  # [k, n]
+
+    def body(_, carry):
+        intree, mind, tot = carry
+        cand = jnp.where(intree, big, mind)  # [k, n]
+        u = jnp.argmin(cand, axis=1)  # [k]
+        wu = jnp.take_along_axis(cand, u[:, None], axis=1)[:, 0]
+        fin = jnp.isfinite(wu)
+        tot = tot + jnp.where(fin, wu, 0.0)
+        intree = intree.at[lanes, u].set(True)
+        mind = jnp.minimum(mind, jnp.where(unvis, dbar[u], big))
+        return intree, mind, tot
+
+    # zero carry derived from p_cost so its varying-axis type matches the
+    # body outputs under shard_map (same trick as _expand_loop's carries)
+    _, _, mst = jax.lax.fori_loop(
+        0, n - 1, body, (init_intree, init_mind, (p_cost * 0).astype(dbar.dtype))
+    )
+
+    # connection edges: cheapest cur->U and cheapest 0->U; at the root
+    # (cur == 0) both come from row 0, which must then supply its TWO
+    # cheapest edges — the 1-tree construction
+    row_cur = jnp.where(unvis, dbar[cur], big)  # [k, n]
+    row_0 = jnp.where(unvis, dbar[0][None, :], big)  # [k, n]
+    min_cur = row_cur.min(axis=1)
+    neg2, _ = jax.lax.top_k(-row_0, 2)  # two smallest of row 0
+    conn = jnp.where(
+        cur == 0,
+        -neg2[:, 0] - neg2[:, 1],
+        min_cur + row_0.min(axis=1),
+    )
+    # |U| == 1 with cur == 0 (n == 2 only): top_k would double-count the
+    # single edge; unreachable since solve() requires n >= 3
+    conn = jnp.where(jnp.isfinite(conn), conn, big)
+
+    sum_pi_u = jnp.sum(jnp.where(unvis, pi[None, :], 0.0), axis=1)
+    return p_cost + mst + conn - pi[cur] - pi[0] - 2.0 * sum_pi_u
+
+
+@partial(jax.jit, static_argnames=("k", "n", "integral", "use_mst"))
 def _expand_step(
     fr: Frontier,
     inc_cost: jnp.ndarray,
@@ -212,16 +357,39 @@ def _expand_step(
     d: jnp.ndarray,
     min_out: jnp.ndarray,
     bound_adj: jnp.ndarray,
+    dbar: jnp.ndarray,
+    pi: jnp.ndarray,
+    mst_slack: jnp.ndarray,
     k: int,
     n: int,
+    integral: bool = False,
+    use_mst: bool = True,
 ):
-    """Pop <=K nodes, expand, prune, push. Returns (frontier', inc', stats)."""
+    """Pop <=K nodes, expand, prune, push. Returns (frontier', inc', stats).
+
+    ``integral``: the metric is integer-valued and the bound arrays are
+    fixed-point-exact (_bound_setup), so a node with ``bound > inc - 1``
+    cannot yield a strictly better integer tour — prune at ``<= inc - 1``
+    instead of ``< inc``. This collapses the optimal-cost plateau (nodes
+    whose bound equals the incumbent) that plain strict pruning floods the
+    frontier with.
+
+    ``use_mst``: re-bound every popped node with the much stronger
+    reduced-cost MST bound (_batched_mst_bound) before expanding it; nodes
+    that fail are discarded without spawning children.
+    """
     f_cap = fr.path.shape[0]
     lanes = jnp.arange(k, dtype=jnp.int32)
     # pop the top-of-stack K entries (stack grows upward)
     take = jnp.minimum(fr.count, k)
     idx = jnp.maximum(fr.count - 1 - lanes, 0)  # top-first
     live = lanes < take
+    # pop-side re-prune: the incumbent may have improved since these nodes
+    # were pushed — discard (already-popped) nodes that can no longer win
+    if integral:
+        live = live & (fr.bound[idx] <= inc_cost - 1.0)
+    else:
+        live = live & (fr.bound[idx] < inc_cost)
 
     p_path = fr.path[idx]
     p_mask = fr.mask[idx]
@@ -234,12 +402,27 @@ def _expand_step(
     cities = jnp.arange(n, dtype=jnp.int32)
     # p_mask is [k, W]; gather each city's word, then test its bit
     unvis = (p_mask[:, word_idx] >> bit[None, :]) & 1 == 0
+
+    if use_mst:
+        # the full rounding slack comes off the strong bound itself (it must
+        # cover the prefix-cost accumulation too, not just the MST edges);
+        # zero on the fixed-point-exact integral path
+        strong = _batched_mst_bound(dbar, pi, unvis, cur, p_cost, n) - mst_slack
+        if integral:
+            live = live & (strong <= inc_cost - 1.0)
+        else:
+            live = live & (strong < inc_cost)
+
     feasible = unvis & live[:, None]
     ccost = p_cost[:, None] + d[cur]  # d[cur] is the [k, n] outgoing-edge block
     # child bound: ccost + sum over must-leave cities (child + remaining),
     # plus the per-child potential correction (zeros in plain min-out mode,
-    # pi[child] - pi[0] under the 1-tree bound — ops.one_tree.bound_arrays)
+    # pi[child] - pi[0] under the 1-tree bound — see _bound_setup)
     cbound = ccost + p_sum[:, None] + bound_adj[None, :]
+    if use_mst:
+        # a parent's MST bound lower-bounds every child too (the child's
+        # completions are a subset of the parent's) — inherit the tighter one
+        cbound = jnp.maximum(cbound, strong[:, None])
     cdepth = p_depth[:, None] + 1
 
     # completions: child is the last unvisited city -> close to 0
@@ -257,7 +440,12 @@ def _expand_step(
     new_inc_tour = jnp.where(best_total < inc_cost, cand_tour, inc_tour)
 
     # pushable children: feasible, not complete, bound under incumbent
-    push = feasible & ~is_complete & (cbound < new_inc_cost)
+    # (integral metric: a child with ceil(bound) >= inc can't strictly
+    # improve — with exact fixed-point bounds that is bound > inc - 1)
+    if integral:
+        push = feasible & ~is_complete & (cbound <= new_inc_cost - 1.0)
+    else:
+        push = feasible & ~is_complete & (cbound < new_inc_cost)
     child_mask = p_mask[:, None, :] | set_bit[None, :, :]  # [k, n, W]
     child_sum = p_sum[:, None] - min_out[None, :]
     child_path = jnp.broadcast_to(p_path[:, None, :], (k, n, n))
@@ -302,7 +490,9 @@ def _expand_step(
     )
 
 
-@partial(jax.jit, static_argnames=("k", "n", "inner_steps"))
+@partial(
+    jax.jit, static_argnames=("k", "n", "inner_steps", "integral", "use_mst")
+)
 def _expand_loop(
     fr: Frontier,
     inc_cost: jnp.ndarray,
@@ -310,9 +500,14 @@ def _expand_loop(
     d: jnp.ndarray,
     min_out: jnp.ndarray,
     bound_adj: jnp.ndarray,
+    dbar: jnp.ndarray,
+    pi: jnp.ndarray,
+    mst_slack: jnp.ndarray,
     k: int,
     n: int,
     inner_steps: int,
+    integral: bool = False,
+    use_mst: bool = True,
 ):
     """Run up to ``inner_steps`` expansion steps in ONE device program.
 
@@ -327,7 +522,8 @@ def _expand_loop(
     def body(carry):
         fr, ic, itour, nodes, i = carry
         fr, ic, itour, stats = _expand_step(
-            fr, ic, itour, d, min_out, bound_adj, k, n
+            fr, ic, itour, d, min_out, bound_adj, dbar, pi, mst_slack, k, n,
+            integral, use_mst
         )
         return fr, ic, itour, nodes + stats["popped"], i + 1
 
@@ -366,12 +562,16 @@ def solve(
     checkpoint_every: int = 0,
     resume_from: Optional[str] = None,
     bound: str = "one-tree",
+    mst_prune: bool = True,
 ) -> BnBResult:
     """Exact B&B on one device. ``d`` is a dense [n, n] distance matrix.
 
     ``bound``: "one-tree" (default — Held-Karp potentials sharpen every
     node bound, usually orders of magnitude fewer nodes) or "min-out"
     (the plain cheapest-outgoing-edge bound).
+
+    ``mst_prune``: re-bound every popped node with the reduced-cost MST
+    bound before expansion (strong pruning; see _batched_mst_bound).
 
     Stops when the frontier empties (proven optimal), or at
     ``max_iters``/``time_limit_s``/``target_cost`` (then best-so-far).
@@ -383,7 +583,8 @@ def solve(
             f"B&B engine supports 3 <= n <= {MAX_BNB_CITIES} cities, got {n}"
         )
     d32 = jnp.asarray(d, jnp.float32)
-    min_out, bound_adj, root_lb = _bound_setup(d, bound)
+    bd = _bound_setup(d, bound)
+    min_out, bound_adj, root_lb, integral = bd.min_out, bd.bound_adj, bd.root_lb, bd.integral
     min_out_np = np.asarray(min_out, np.float64)
 
     if resume_from:
@@ -404,7 +605,8 @@ def solve(
     inner = max(1, inner_steps)
     while it < max_iters:
         fr, inc_cost, inc_tour, popped = _expand_loop(
-            fr, inc_cost, inc_tour, d32, min_out, bound_adj, k, n, inner
+            fr, inc_cost, inc_tour, d32, min_out, bound_adj, bd.dbar, bd.pi,
+            bd.slack, k, n, inner, integral, mst_prune
         )
         nodes += int(popped)
         it += inner
@@ -449,6 +651,7 @@ def solve_sharded(
     max_iters: int = 200_000,
     time_limit_s: Optional[float] = None,
     bound: str = "one-tree",
+    mst_prune: bool = True,
 ) -> BnBResult:
     """Mesh-parallel B&B: per-rank frontiers, collective incumbent sharing.
 
@@ -473,7 +676,8 @@ def solve_sharded(
     num_ranks = int(mesh.devices.size)
     d32 = jnp.asarray(d, jnp.float32)
     d_np = np.asarray(d, np.float64)
-    min_out, bound_adj, root_lb = _bound_setup(d, bound)
+    bd = _bound_setup(d, bound)
+    min_out, bound_adj, root_lb, integral = bd.min_out, bd.bound_adj, bd.root_lb, bd.integral
     min_out_np = np.asarray(min_out, np.float64)
 
     inc_tour_np = strong_incumbent(d)
@@ -515,10 +719,12 @@ def solve_sharded(
         np.broadcast_to(inc_tour_np, (num_ranks, n + 1)).copy(), spec
     )
 
-    def rank_body(fr_stacked, ic_l, itour_l, d_rep, mo_rep, ba_rep):
+    def rank_body(fr_stacked, ic_l, itour_l, d_rep, mo_rep, ba_rep, dbar_rep,
+                  pi_rep, slack_rep):
         local = Frontier(*(x[0] for x in fr_stacked))
         f2, c2, t2, nodes = _expand_loop(
-            local, ic_l[0], itour_l[0], d_rep, mo_rep, ba_rep, k, n, inner_steps
+            local, ic_l[0], itour_l[0], d_rep, mo_rep, ba_rep, dbar_rep,
+            pi_rep, slack_rep, k, n, inner_steps, integral, mst_prune
         )
         all_c = jax.lax.all_gather(c2, RANK_AXIS)
         all_t = jax.lax.all_gather(t2, RANK_AXIS)
@@ -544,6 +750,9 @@ def solve_sharded(
                 P(None, None),
                 P(None),
                 P(None),
+                P(None, None),
+                P(None),
+                P(),
             ),
             out_specs=(
                 tuple(P(RANK_AXIS) for _ in Frontier._fields),
@@ -561,7 +770,8 @@ def solve_sharded(
     nodes = 0
     it = 0
     while it < max_iters:
-        out = step(tuple(fr), ic, itour, d32, min_out, bound_adj)
+        out = step(tuple(fr), ic, itour, d32, min_out, bound_adj, bd.dbar,
+                   bd.pi, bd.slack)
         fr = Frontier(*out[0])
         ic, itour, total, step_nodes = out[1], out[2], out[3], out[4]
         nodes += int(step_nodes[0])

@@ -402,14 +402,12 @@ def solve_blocks_from_dists(dists, dtype=jnp.float64) -> Tuple[jnp.ndarray, jnp.
         raise ValueError(f"expected [B, n, n] distance matrices, got {dists.shape}")
     n = int(dists.shape[1])
     impl = _effective_impl(dtype)
-    # the Pallas kernels only compile for TPU (Mosaic); anywhere else they
-    # run in interpret mode
-    # Pallas kernels compile only for real accelerators (Mosaic); interpret
-    # mode is for CPU CI. Gate on == "cpu" so any accelerator platform
-    # string (the remote plugin also reports "tpu", but don't rely on it)
-    # takes the compiled path.
+    # The Pallas kernels lower through Mosaic, which exists only for TPU;
+    # every other platform (CPU CI, a hypothetical GPU) runs them in
+    # interpret mode rather than hitting a lowering error.
     interpret = (
-        impl in ("pallas", "fused") and jax.devices()[0].platform == "cpu"
+        impl in ("pallas", "fused")
+        and "tpu" not in jax.devices()[0].platform.lower()
     )
     if not interpret and impl in ("pallas", "fused") and (
         jnp.dtype(dtype) == jnp.float64

@@ -113,23 +113,39 @@ def held_karp_potentials(
     return best_pi, best_w
 
 
-def bound_arrays(d, pi) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """B&B weight arrays from potentials: ``(weights, bound_adj)``.
+def one_tree_value_np(d64, pi64) -> float:
+    """Host float64 re-evaluation of ``w(pi)`` for given potentials.
 
-    For a node with true prefix cost ``c`` (edge to ``child`` included) and
-    to-leave set S = {child} ∪ unvisited, a valid lower bound is
-
-        c + sum_{u in S} weights[u] + bound_adj[child]
-
-    with ``weights[u] = min_out_d̄(u) - 2*pi[u]`` and ``bound_adj[v] =
-    pi[v] - pi[0]``: each u in S is left exactly once (min reduced outgoing
-    edge), each unvisited + city 0 is entered exactly once, and the pi
-    telescopes leave exactly the child/0 correction. pi = zeros reduces to
-    the plain min-out bound.
+    The on-device ascent runs in float32, whose rounding can OVERstate the
+    1-tree value — unusable as a certified lower bound. This recomputes
+    ``onetree(d + pi_i + pi_j) - 2*sum(pi)`` with numpy float64 (Prim's
+    O(n^2)), so the reported root bound is true to ~1e-12 relative.
     """
-    n = d.shape[0]
-    pp = pi[:, None] + pi[None, :]
-    dbar = jnp.where(jnp.eye(n, dtype=bool), INF, d + pp)
-    weights = dbar.min(axis=1) - 2.0 * pi
-    bound_adj = pi - pi[0]
-    return weights, bound_adj
+    import numpy as np
+
+    d64 = np.asarray(d64, np.float64)
+    pi64 = np.asarray(pi64, np.float64)
+    n = d64.shape[0]
+    dbar = d64 + pi64[:, None] + pi64[None, :]
+    np.fill_diagonal(dbar, np.inf)
+    # Prim over vertices 1..n-1
+    sub = dbar[1:, 1:]
+    m = n - 1
+    in_tree = np.zeros(m, bool)
+    in_tree[0] = True
+    mindist = sub[0].copy()
+    cost = 0.0
+    for _ in range(m - 1):
+        cand = np.where(in_tree, np.inf, mindist)
+        u = int(np.argmin(cand))
+        cost += cand[u]
+        in_tree[u] = True
+        mindist = np.minimum(mindist, sub[u])
+    e0 = np.sort(dbar[0, 1:])[:2].sum()
+    return float(cost + e0 - 2.0 * pi64.sum())
+
+
+# NOTE: the B&B weight/adjustment arrays derived from these potentials
+# (weights[u] = min reduced outgoing edge - 2*pi[u], bound_adj[v] =
+# pi[v] - pi[0]) are built in models.branch_bound._bound_setup, which owns
+# the float32 quantization/slack logic that makes them certified bounds.

@@ -25,12 +25,20 @@ from ..ops.local_search import two_opt_sweep
 from .mesh import RANK_AXIS
 
 
+#: Above this tour length the final single-device polish is skipped: an
+#: O(N^2) full-tour sweep would dominate exactly the long-tour regime the
+#: ring decomposition exists to shard (the rotation rounds already move
+#: every boundary into some segment's interior).
+FINAL_POLISH_MAX = 4096
+
+
 def ring_two_opt(
     tour: jnp.ndarray,
     d: jnp.ndarray,
     mesh,
     rounds: Optional[int] = None,
     max_iters_per_sweep: int = 256,
+    final_polish_max: int = FINAL_POLISH_MAX,
 ) -> jnp.ndarray:
     """Improve a closed tour (given as [N] open order) on a device mesh.
 
@@ -80,11 +88,15 @@ def ring_two_opt(
             out_specs=P(RANK_AXIS),
         )
     )(sharded, d)
+    assembled = out.reshape(-1)
+    if n > final_polish_max:
+        return assembled
     # one final closed polish (2-opt + Or-opt) on the assembled tour catches
-    # any remaining cross-boundary move (single-device; cheap vs the ring phase)
+    # any remaining cross-boundary move; only worthwhile while the O(N^2)
+    # sweep is cheap relative to the ring phase (see FINAL_POLISH_MAX)
     from ..ops.local_search import polish
 
-    improved, _ = polish(out.reshape(-1), d)
+    improved, _ = polish(assembled, d)
     return improved
 
 

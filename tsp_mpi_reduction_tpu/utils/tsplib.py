@@ -241,3 +241,51 @@ EOF
 
 def burma14() -> TSPLIBInstance:
     return parse(BURMA14)
+
+
+def embedded(name: str) -> TSPLIBInstance:
+    """Load an embedded instance by TSPLIB name (see utils.tsplib_data).
+
+    Every embedded coordinate set is validated against its published
+    optimum by tests/test_tsplib.py (exact proof or bound bracketing) —
+    see the tsplib_data module docstring.
+    """
+    try:
+        return parse(EMBEDDED[name])
+    except KeyError:
+        raise KeyError(
+            f"no embedded instance {name!r}; available: {sorted(EMBEDDED)}"
+        ) from None
+
+
+def _ulysses16_text() -> str:
+    """ulysses16 is, by TSPLIB construction, the first 16 ulysses22 cities."""
+    from . import tsplib_data
+
+    lines = tsplib_data.ULYSSES22.splitlines()
+    head = [
+        "NAME: ulysses16",
+        "TYPE: TSP",
+        "COMMENT: Odyssey of Ulysses (Groetschel/Padberg)",
+        "DIMENSION: 16",
+        "EDGE_WEIGHT_TYPE: GEO",
+        "NODE_COORD_SECTION",
+    ]
+    coords = [ln for ln in lines if ln.strip() and ln.strip()[0].isdigit()][:16]
+    return "\n".join(head + coords + ["EOF", ""])
+
+
+def _build_embedded() -> Dict[str, str]:
+    from . import tsplib_data
+
+    return {
+        "burma14": BURMA14,
+        "ulysses16": _ulysses16_text(),
+        "ulysses22": tsplib_data.ULYSSES22,
+        "eil51": tsplib_data.EIL51,
+        "berlin52": tsplib_data.BERLIN52,
+        "kroA100": tsplib_data.KROA100,
+    }
+
+
+EMBEDDED: Dict[str, str] = _build_embedded()
