@@ -1,24 +1,33 @@
-"""Benchmark: full blocked pipeline, 16 cities x 100 blocks (headline config).
+"""Benchmark driver. Prints ONE JSON line: {"metric","value","unit","vs_baseline"}.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Two modes, selected by ``TSP_BENCH`` (default ``pipeline``):
 
-Baseline: the unmodified reference solving the same deterministic instance
-single-rank takes 69997 ms (BASELINE.md, measured in this environment at
-g++ -O2; the instance is identical because generation is srand(0)-
-deterministic). ``vs_baseline`` is the speedup factor (baseline_ms / ours).
+- ``pipeline`` — full blocked pipeline, 16 cities x 100 blocks (headline
+  config). Baseline: the unmodified reference solving the same
+  deterministic instance single-rank takes 69997 ms (BASELINE.md, measured
+  in this environment at g++ -O2; identical instance because generation is
+  srand(0)-deterministic). ``vs_baseline`` = baseline_ms / ours.
+  Method: device pipeline in float32 (TPU speed mode) — on-device distance
+  matrix, vmapped dense Held-Karp over all 100 blocks, scan merge fold.
+  Compiled once (warmup), then median of 3 timed end-to-end executions.
 
-Method: device pipeline in float32 (TPU speed mode) — on-device distance
-matrix, vmapped dense Held-Karp over all 100 blocks, scan merge fold.
-The jitted step is compiled once (warmup), then the median of 3 timed
-end-to-end executions (host->device input transfer + full compute +
-device->host result transfer) is reported. Compile time is excluded (the
-reference has no JIT; with the persistent compilation cache it is a
-one-time cost) and printed to stderr for transparency.
+- ``bnb`` — the north-star metric (BASELINE.json): B&B nodes/sec on TSPLIB
+  berlin52, solved to PROVEN optimality (cost 7542). The reference has no
+  B&B and no TSPLIB mode (SURVEY.md §0 discrepancy note), so there is no
+  reference binary to time; the baseline anchor is this engine's own
+  single-rank CPU rate x8 — a stand-in for the north star's "8-rank MPI"
+  comparison that generously assumes perfect MPI scaling
+  (BNB_CPU_8RANK_ANCHOR below, measured on this host). ``vs_baseline`` =
+  device nodes/sec / anchor. Warmup excludes compile from the timed run.
+
+Compile time is excluded in both modes (the reference has no JIT; with the
+persistent compilation cache it is a one-time cost) and printed to stderr.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -26,6 +35,13 @@ import numpy as np
 
 BASELINE_MS = 69997.0  # BASELINE.md: 16 cities/block x 100 blocks, 1 rank
 N, BLOCKS, GRID = 16, 100, 1000
+
+#: Single-rank CPU B&B nodes/sec on berlin52 (this engine, this host,
+#: k=256, proven-optimal run, compile excluded) x 8 ranks — i.e. the
+#: anchor generously assumes perfect 8-way MPI scaling of our own CPU
+#: rate. Measured 2026-07-29 (38,040 nodes/s, proof in 1.07 s); see
+#: BENCHMARKS.md for the recorded run.
+BNB_CPU_8RANK_ANCHOR = 8 * 38000.0
 
 
 def _accelerator_usable(timeout_s: float = 180.0) -> bool:
@@ -62,6 +78,48 @@ def _accelerator_usable(timeout_s: float = 180.0) -> bool:
         return False
 
 
+def bench_bnb() -> int:
+    """North-star metric: B&B nodes/sec to proven optimality on berlin52."""
+    import jax
+
+    from tsp_mpi_reduction_tpu.models import branch_bound as bb
+    from tsp_mpi_reduction_tpu.utils import tsplib
+
+    dev = jax.devices()[0]
+    print(f"bench device: {dev}", file=sys.stderr)
+    name = os.environ.get("TSP_BENCH_INSTANCE", "berlin52")
+    inst = tsplib.embedded(name)
+    d = inst.distance_matrix()
+    k = int(os.environ.get("TSP_BENCH_K", "256"))
+
+    t0 = time.perf_counter()
+    bb.solve(d, capacity=1 << 17, k=k, inner_steps=8, max_iters=8)
+    print(f"warmup (compile): {time.perf_counter() - t0:.1f}s", file=sys.stderr)
+
+    res = bb.solve(d, capacity=1 << 17, k=k, inner_steps=8, time_limit_s=600)
+    ok = res.proven_optimal and res.cost == inst.known_optimum
+    print(
+        f"{name}: cost={res.cost} (known {inst.known_optimum}) "
+        f"proven={res.proven_optimal} nodes={res.nodes_expanded} "
+        f"wall={res.wall_seconds:.2f}s time_to_best={res.time_to_best:.2f}s",
+        file=sys.stderr,
+    )
+    if not ok:
+        print("bench: WARNING — run did not prove the known optimum", file=sys.stderr)
+    value = res.nodes_per_sec
+    print(
+        json.dumps(
+            {
+                "metric": f"bnb_{name}_nodes_per_sec",
+                "value": round(value, 1),
+                "unit": "nodes/s",
+                "vs_baseline": round(value / BNB_CPU_8RANK_ANCHOR, 2),
+            }
+        )
+    )
+    return 0
+
+
 def main() -> int:
     if not _accelerator_usable():
         print(
@@ -73,7 +131,8 @@ def main() -> int:
 
         select_backend("cpu")
 
-    import os
+    if os.environ.get("TSP_BENCH", "pipeline") == "bnb":
+        return bench_bnb()
 
     import jax
     import jax.numpy as jnp

@@ -76,3 +76,67 @@ def test_ceil_metric():
     c = np.array([[0.0, 0.0], [3.0, 4.1]])
     d = tsplib._ceil_2d(c)
     assert d[0, 1] == 6  # ceil(5.08..)
+
+
+# --- embedded fixture validation (utils.tsplib_data) ---
+# The coordinates were embedded from public knowledge in a zero-egress
+# environment; these tests are what makes them trustworthy. Wrong data
+# could not produce a Held-Karp bound AND a local-search tour that both
+# land exactly on the published optimum.
+
+
+def test_embedded_registry_complete():
+    for name in ("burma14", "ulysses16", "ulysses22", "eil51", "berlin52", "kroA100"):
+        inst = tsplib.embedded(name)
+        assert inst.name == name
+        assert inst.dimension == inst.distance_matrix().shape[0]
+        assert inst.known_optimum is not None
+    with pytest.raises(KeyError):
+        tsplib.embedded("pr124")  # deliberately not embedded (see tsplib_data)
+
+
+@pytest.mark.parametrize("name", ["ulysses16", "ulysses22", "berlin52"])
+def test_embedded_root_bound_equals_published_optimum(name):
+    """For these instances the Held-Karp 1-tree bound is EXACTLY the
+    published optimum — the strongest possible data check short of a full
+    proof (which tests/test_bnb.py + the recorded runs provide)."""
+    from tsp_mpi_reduction_tpu.models import branch_bound as bb
+
+    inst = tsplib.embedded(name)
+    bd = bb._bound_setup(inst.distance_matrix(), "one-tree")
+    assert bd.root_lb == inst.known_optimum
+
+
+@pytest.mark.parametrize("name,lb_floor", [("eil51", 420), ("kroA100", 20800)])
+def test_embedded_bound_brackets_published_optimum(name, lb_floor):
+    import numpy as np
+
+    from tsp_mpi_reduction_tpu.models import branch_bound as bb
+
+    inst = tsplib.embedded(name)
+    d = inst.distance_matrix()
+    bd = bb._bound_setup(d, "one-tree")
+    tour = bb.strong_incumbent(d, starts=16)
+    ub = bb.tour_cost(np.asarray(d, np.float64), tour)
+    assert lb_floor <= bd.root_lb <= inst.known_optimum <= ub
+
+
+@pytest.mark.slow
+def test_ulysses22_proven_optimal():
+    from tsp_mpi_reduction_tpu.models import branch_bound as bb
+
+    inst = tsplib.embedded("ulysses22")
+    res = bb.solve(inst.distance_matrix(), capacity=1 << 15, k=128)
+    assert res.proven_optimal and res.cost == 7013.0
+
+
+@pytest.mark.slow
+def test_berlin52_proven_optimal():
+    """The north-star acceptance instance: identical optimal tour cost."""
+    from tsp_mpi_reduction_tpu.models import branch_bound as bb
+
+    inst = tsplib.embedded("berlin52")
+    res = bb.solve(inst.distance_matrix(), capacity=1 << 17, k=256,
+                   time_limit_s=300)
+    assert res.proven_optimal and res.cost == 7542.0
+    assert sorted(res.tour[:-1].tolist()) == list(range(52))

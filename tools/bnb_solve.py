@@ -63,12 +63,6 @@ def main() -> int:
     d = inst.distance_matrix()
 
     if args.ranks > 1:
-        if args.checkpoint or args.resume:
-            print(
-                "warning: --checkpoint/--resume are not supported with "
-                "--ranks > 1 yet and will be ignored",
-                file=sys.stderr,
-            )
         from tsp_mpi_reduction_tpu.parallel.mesh import make_rank_mesh
 
         res = bb.solve_sharded(
@@ -79,6 +73,9 @@ def main() -> int:
             inner_steps=args.inner_steps,
             time_limit_s=args.time_limit,
             bound=args.bound,
+            checkpoint_path=args.checkpoint,
+            checkpoint_every=args.checkpoint_every,
+            resume_from=args.resume,
         )
     else:
         res = bb.solve(

@@ -59,7 +59,12 @@ class Frontier(NamedTuple):
     bound: jnp.ndarray  # [F] float32 admissible lower bound
     sum_min: jnp.ndarray  # [F] float32 sum of min_out over unvisited
     count: jnp.ndarray  # scalar int32: stack height
-    overflow: jnp.ndarray  # scalar bool: capacity was exceeded (exactness lost)
+    #: scalar bool: a push batch overran capacity INSIDE the kernel (children
+    #: silently dropped -> exactness lost). solve()'s spill-to-reservoir keeps
+    #: headroom so this is unreachable when inner_steps*k*(n-1) <= capacity/2
+    #: (and rare otherwise); proven_optimal always checks it, so exactness is
+    #: never silently lost.
+    overflow: jnp.ndarray
 
 
 @dataclass
@@ -75,6 +80,8 @@ class BnBResult:
     #: proven lower bound at the root (1-tree value; min-out sum otherwise) —
     #: reported so callers can state the optimality gap when stopping early
     root_lower_bound: float = -np.inf
+    #: per-rank expansion counts (solve_sharded only) — load-balance evidence
+    nodes_per_rank: Optional[np.ndarray] = None
 
 
 def nearest_neighbor_tour(d: np.ndarray, start: int = 0) -> np.ndarray:
@@ -536,6 +543,90 @@ def _expand_loop(
     return fr, inc_cost, inc_tour, nodes
 
 
+#: Frontier's per-node SoA fields (everything except count/overflow) — the
+#: single source of truth for code that moves nodes between stores (host
+#: reservoir spill, ring-balance donation, checkpoints)
+NODE_FIELDS = tuple(f for f in Frontier._fields if f not in ("count", "overflow"))
+
+
+class _Reservoir:
+    """Host-side overflow store for frontier nodes (SoA numpy chunks).
+
+    When the device stack nears capacity, the worst-bound bottom half is
+    spilled here instead of tripping the kernel's lossy overflow flag; when
+    the device frontier empties, nodes flow back (filtered against the
+    current incumbent). Exactness is preserved: a node is only ever
+    discarded by a certified bound check.
+    """
+
+    _ARRAYS = NODE_FIELDS
+
+    def __init__(self):
+        self.chunks: list = []
+
+    def __len__(self) -> int:
+        return sum(int(c["depth"].shape[0]) for c in self.chunks)
+
+    def spill(self, fr: Frontier, keep: int) -> Frontier:
+        """Move all but the top ``keep`` stack entries to the host."""
+        cnt = int(fr.count)
+        cut = max(cnt - keep, 0)
+        if cut == 0:
+            return fr
+        # one device->host transfer of the live prefix per field; entries at
+        # or above the new count are dead (pushes overwrite before any read),
+        # so only the kept slice needs to go back up
+        arrays = {f: np.asarray(getattr(fr, f)[:cnt]) for f in self._ARRAYS}
+        self.chunks.append({f: arrays[f][:cut].copy() for f in self._ARRAYS})
+        out = {
+            f: getattr(fr, f).at[: cnt - cut].set(arrays[f][cut:cnt])
+            for f in self._ARRAYS
+        }
+        return Frontier(
+            count=jnp.asarray(cnt - cut, jnp.int32),
+            overflow=fr.overflow,
+            **out,
+        )
+
+    def refill(self, fr: Frontier, inc_cost: float, integral: bool) -> Frontier:
+        """Reload up to half the capacity from the reservoir onto an empty
+        device stack, dropping nodes the incumbent has since closed."""
+        capacity = fr.path.shape[0]
+        merged = {
+            f: np.concatenate([c[f] for c in self.chunks]) for f in self._ARRAYS
+        }
+        self.chunks = []
+        alive = (
+            merged["bound"] <= inc_cost - 1.0
+            if integral
+            else merged["bound"] < inc_cost
+        )
+        for f in self._ARRAYS:
+            merged[f] = merged[f][alive]
+        m = merged["depth"].shape[0]
+        take = min(m, capacity // 2)
+        if take < m:
+            # reload the BEST-bound nodes first; the rest stays spilled
+            order = np.argsort(merged["bound"], kind="stable")
+            sel = order[:take]
+            self.chunks.append({f: merged[f][order[take:]] for f in self._ARRAYS})
+            merged = {f: merged[f][sel] for f in self._ARRAYS}
+        if take == 0:
+            return fr
+        # stack order: worst bound at the bottom, best on top (pop side)
+        order = np.argsort(-merged["bound"], kind="stable")
+        arrays = {}
+        for f in self._ARRAYS:
+            buf = np.asarray(getattr(fr, f)).copy()
+            buf[:take] = merged[f][order]
+            arrays[f] = jnp.asarray(buf)
+        return Frontier(
+            count=jnp.asarray(take, jnp.int32),
+            overflow=fr.overflow,
+            **arrays,
+        )
+
+
 def make_root_frontier(n: int, capacity: int, min_out: np.ndarray, dtype=jnp.float32) -> Frontier:
     w = (n + 31) // 32
     path = jnp.zeros((capacity, n), jnp.int32)
@@ -587,8 +678,14 @@ def solve(
     min_out, bound_adj, root_lb, integral = bd.min_out, bd.bound_adj, bd.root_lb, bd.integral
     min_out_np = np.asarray(min_out, np.float64)
 
+    reservoir = _Reservoir()
     if resume_from:
-        fr, inc_cost, inc_tour = restore(resume_from, expect_d=d, expect_bound=bound)
+        fr, inc_cost, inc_tour, reservoir = restore(
+            resume_from, expect_d=d, expect_bound=bound
+        )
+        # the restored arrays define the true capacity — the caller's
+        # argument must not disarm the spill trigger below
+        capacity = int(fr.path.shape[0])
     else:
         inc_tour_np = strong_incumbent(d)
         inc_cost = jnp.asarray(
@@ -597,6 +694,10 @@ def solve(
         inc_tour = jnp.asarray(inc_tour_np, jnp.int32)
         fr = make_root_frontier(n, capacity, min_out_np)
 
+    # spill before a single inner batch could possibly overflow the stack
+    # (each of the ``inner`` steps pushes at most k*(n-1) children); for
+    # small capacities fall back to keeping the top half
+    headroom = min(capacity // 2, max(1, inner_steps) * k * (n - 1))
     t0 = time.perf_counter()
     t_best = 0.0
     last_inc = float(inc_cost)
@@ -615,8 +716,16 @@ def solve(
         if ic < last_inc:
             last_inc = ic
             t_best = time.perf_counter() - t0
+        if cnt == 0 and len(reservoir):
+            fr = reservoir.refill(fr, ic, integral)
+            cnt = int(fr.count)
+        elif cnt > capacity - headroom:
+            fr = reservoir.spill(fr, keep=capacity // 2)
+        # checkpoint AFTER the spill/refill: a pre-spill snapshot could be
+        # resumed into an immediate in-kernel overflow
         if checkpoint_every and checkpoint_path and it % max(checkpoint_every, inner) < inner:
-            save(checkpoint_path, fr, inc_cost, inc_tour, d=d, bound=bound)
+            save(checkpoint_path, fr, inc_cost, inc_tour, d=d, bound=bound,
+                 reservoir=reservoir)
         if cnt == 0:
             break
         if time_limit_s is not None and time.perf_counter() - t0 > time_limit_s:
@@ -624,11 +733,14 @@ def solve(
         if target_cost is not None and ic <= target_cost:
             break
     wall = time.perf_counter() - t0
-    proven = int(fr.count) == 0 and not bool(fr.overflow)
+    proven = (
+        int(fr.count) == 0 and len(reservoir) == 0 and not bool(fr.overflow)
+    )
     if checkpoint_path and not proven:
         # always leave a resumable snapshot when stopping early (time limit,
         # iteration cap, target reached)
-        save(checkpoint_path, fr, inc_cost, inc_tour, d=d, bound=bound)
+        save(checkpoint_path, fr, inc_cost, inc_tour, d=d, bound=bound,
+             reservoir=reservoir)
     return BnBResult(
         cost=float(inc_cost),
         tour=np.asarray(inc_tour),
@@ -652,6 +764,11 @@ def solve_sharded(
     time_limit_s: Optional[float] = None,
     bound: str = "one-tree",
     mst_prune: bool = True,
+    transfer: Optional[int] = None,
+    seed_mode: str = "round-robin",
+    checkpoint_path: Optional[str] = None,
+    checkpoint_every: int = 0,
+    resume_from: Optional[str] = None,
 ) -> BnBResult:
     """Mesh-parallel B&B: per-rank frontiers, collective incumbent sharing.
 
@@ -660,8 +777,19 @@ def solve_sharded(
     children), and after every inner batch the incumbent cost/tour is
     shared across the mesh with ``all_gather`` + argmin — the collective
     form of the reference-era ``MPI_Allreduce(MPI_MIN)`` incumbent
-    broadcast, riding the ICI. Work stays static per rank this round
-    (no stealing); idle ranks simply run empty loops.
+    broadcast, riding the ICI.
+
+    Load balance: after every inner batch each rank donates up to
+    ``transfer`` top-of-stack nodes to its ring successor when it holds
+    more than the successor — neighbor counts and fixed-shape node buffers
+    move with ``ppermute`` (the ICI version of MPI work-stealing; amounts
+    are data-dependent but shapes are static, so the whole exchange stays
+    inside one compiled program). Work seeded on a single rank diffuses
+    around the ring in ~num_ranks rounds.
+
+    ``seed_mode``: "round-robin" (default) splits the root's children over
+    ranks; "single-rank" piles them all on rank 0 — the adversarial case
+    used to test that balancing works.
     """
     from jax import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -680,44 +808,88 @@ def solve_sharded(
     min_out, bound_adj, root_lb, integral = bd.min_out, bd.bound_adj, bd.root_lb, bd.integral
     min_out_np = np.asarray(min_out, np.float64)
 
-    inc_tour_np = strong_incumbent(d)
-    inc_cost0 = tour_cost(d_np, inc_tour_np)
-
-    # seed: depth-2 children of the root, round-robin over ranks
+    # seed: depth-2 children of the root, round-robin over ranks (skipped
+    # when resuming — the checkpoint carries the per-rank stacks)
     sum_min0 = float(min_out_np[1:].sum())
     leaves = {f: [] for f in Frontier._fields}
     n_words = (n + 31) // 32
-    for r in range(num_ranks):
-        path = np.zeros((capacity_per_rank, n), np.int32)
-        mask = np.zeros((capacity_per_rank, n_words), np.uint32)
-        depth = np.zeros(capacity_per_rank, np.int32)
-        cost = np.zeros(capacity_per_rank, np.float32)
-        bound = np.zeros(capacity_per_rank, np.float32)
-        sum_min = np.zeros(capacity_per_rank, np.float32)
-        mine = [c for c in range(1, n) if (c - 1) % num_ranks == r]
+    for r in range(num_ranks if not resume_from else 0):
+        # s_-prefixed locals: do NOT shadow the `bound`/`cost` parameters
+        s_path = np.zeros((capacity_per_rank, n), np.int32)
+        s_mask = np.zeros((capacity_per_rank, n_words), np.uint32)
+        s_depth = np.zeros(capacity_per_rank, np.int32)
+        s_cost = np.zeros(capacity_per_rank, np.float32)
+        s_bound = np.zeros(capacity_per_rank, np.float32)
+        s_sum = np.zeros(capacity_per_rank, np.float32)
+        if seed_mode == "round-robin":
+            mine = [c for c in range(1, n) if (c - 1) % num_ranks == r]
+        elif seed_mode == "single-rank":
+            mine = list(range(1, n)) if r == 0 else []
+        else:
+            raise ValueError(f"unknown seed_mode {seed_mode!r}")
         for slot, c in enumerate(mine):
-            path[slot, 0] = 0
-            path[slot, 1] = c
-            mask[slot, 0] = np.uint32(1)  # city 0
-            mask[slot, c // 32] |= np.uint32(1) << np.uint32(c % 32)
-            depth[slot] = 2
-            cost[slot] = d_np[0, c]
-            bound[slot] = d_np[0, c] + sum_min0 + float(bound_adj[c])
-            sum_min[slot] = sum_min0 - min_out_np[c]
-        leaves["path"].append(path)
-        leaves["mask"].append(mask)
-        leaves["depth"].append(depth)
-        leaves["cost"].append(cost)
-        leaves["bound"].append(bound)
-        leaves["sum_min"].append(sum_min)
+            s_path[slot, 0] = 0
+            s_path[slot, 1] = c
+            s_mask[slot, 0] = np.uint32(1)  # city 0
+            s_mask[slot, c // 32] |= np.uint32(1) << np.uint32(c % 32)
+            s_depth[slot] = 2
+            s_cost[slot] = d_np[0, c]
+            s_bound[slot] = d_np[0, c] + sum_min0 + float(bound_adj[c])
+            s_sum[slot] = sum_min0 - min_out_np[c]
+        leaves["path"].append(s_path)
+        leaves["mask"].append(s_mask)
+        leaves["depth"].append(s_depth)
+        leaves["cost"].append(s_cost)
+        leaves["bound"].append(s_bound)
+        leaves["sum_min"].append(s_sum)
         leaves["count"].append(np.int32(len(mine)))
         leaves["overflow"].append(False)
     spec = NamedSharding(mesh, P(RANK_AXIS))
-    fr = Frontier(*(jax.device_put(np.stack(leaves[f]), spec) for f in Frontier._fields))
-    ic = jax.device_put(np.full(num_ranks, inc_cost0, np.float32), spec)
-    itour = jax.device_put(
-        np.broadcast_to(inc_tour_np, (num_ranks, n + 1)).copy(), spec
-    )
+    if resume_from:
+        fr_h, ic_h, itour_h, _ = restore(
+            resume_from, expect_d=d, expect_bound=bound, expect_ranks=num_ranks
+        )
+        fr = Frontier(
+            *(jax.device_put(np.asarray(x), spec) for x in fr_h)
+        )
+        ic = jax.device_put(np.asarray(ic_h), spec)
+        itour = jax.device_put(np.asarray(itour_h), spec)
+        inc_cost0 = float(np.asarray(ic_h)[0])
+    else:
+        inc_tour_np = strong_incumbent(d)
+        inc_cost0 = tour_cost(d_np, inc_tour_np)
+        fr = Frontier(
+            *(jax.device_put(np.stack(leaves[f]), spec) for f in Frontier._fields)
+        )
+        ic = jax.device_put(np.full(num_ranks, inc_cost0, np.float32), spec)
+        itour = jax.device_put(
+            np.broadcast_to(inc_tour_np, (num_ranks, n + 1)).copy(), spec
+        )
+
+    t_slots = int(transfer) if transfer is not None else max(k, 64)
+    t_slots = min(t_slots, capacity_per_rank // 4)
+    perm_fwd = [(r, (r + 1) % num_ranks) for r in range(num_ranks)]
+    perm_back = [((r + 1) % num_ranks, r) for r in range(num_ranks)]
+
+    def ring_balance(f2: Frontier) -> Frontier:
+        """Diffuse work around the ring: donate top-of-stack nodes to the
+        successor while I hold more than it. Donation size is capped so the
+        receiver can never overflow (recv + m <= (donor + recv)/2 + recv <=
+        capacity while donor <= capacity)."""
+        cnt = f2.count
+        nb_cnt = jax.lax.ppermute(cnt, RANK_AXIS, perm_back)  # successor's count
+        m_out = jnp.clip((cnt - nb_cnt) // 2, 0, t_slots)
+        lanes_t = jnp.arange(t_slots, dtype=jnp.int32)
+        src = jnp.clip(cnt - m_out + lanes_t, 0, capacity_per_rank - 1)
+        m_in = jax.lax.ppermute(m_out, RANK_AXIS, perm_fwd)
+        base = cnt - m_out
+        dest = jnp.where(lanes_t < m_in, base + lanes_t, capacity_per_rank)
+        out = {}
+        for f in NODE_FIELDS:
+            buf = getattr(f2, f)
+            recv = jax.lax.ppermute(buf[src], RANK_AXIS, perm_fwd)
+            out[f] = buf.at[dest].set(recv, mode="drop")
+        return Frontier(count=base + m_in, overflow=f2.overflow, **out)
 
     def rank_body(fr_stacked, ic_l, itour_l, d_rep, mo_rep, ba_rep, dbar_rep,
                   pi_rep, slack_rep):
@@ -726,17 +898,21 @@ def solve_sharded(
             local, ic_l[0], itour_l[0], d_rep, mo_rep, ba_rep, dbar_rep,
             pi_rep, slack_rep, k, n, inner_steps, integral, mst_prune
         )
+        if num_ranks > 1:
+            f2 = ring_balance(f2)
         all_c = jax.lax.all_gather(c2, RANK_AXIS)
         all_t = jax.lax.all_gather(t2, RANK_AXIS)
         b = jnp.argmin(all_c)
         total = jax.lax.psum(f2.count, RANK_AXIS)
         total_nodes = jax.lax.psum(nodes, RANK_AXIS)
+        rank_nodes = jax.lax.all_gather(nodes, RANK_AXIS)
         return (
             jax.tree.map(lambda x: x[None], tuple(f2)),
             all_c[b][None],
             all_t[b][None],
             total[None],
             total_nodes[None],
+            rank_nodes[None],
         )
 
     step = jax.jit(
@@ -760,6 +936,7 @@ def solve_sharded(
                 P(RANK_AXIS),
                 P(RANK_AXIS),
                 P(RANK_AXIS),
+                P(RANK_AXIS),
             ),
         )
     )
@@ -769,17 +946,26 @@ def solve_sharded(
     last_inc = inc_cost0
     nodes = 0
     it = 0
+    rank_nodes = np.zeros(num_ranks, np.int64)
     while it < max_iters:
         out = step(tuple(fr), ic, itour, d32, min_out, bound_adj, bd.dbar,
                    bd.pi, bd.slack)
         fr = Frontier(*out[0])
         ic, itour, total, step_nodes = out[1], out[2], out[3], out[4]
+        rank_nodes = rank_nodes + np.asarray(out[5][0])
         nodes += int(step_nodes[0])
         it += inner_steps
         best = float(ic[0])
         if best < last_inc:
             last_inc = best
             t_best = time.perf_counter() - t0
+        if (
+            checkpoint_every
+            and checkpoint_path
+            and it % max(checkpoint_every, inner_steps) < inner_steps
+        ):
+            save(checkpoint_path, fr, ic, itour, d=d, bound=bound,
+                 num_ranks=num_ranks)
         if int(total[0]) == 0:
             break
         if time_limit_s is not None and time.perf_counter() - t0 > time_limit_s:
@@ -787,6 +973,9 @@ def solve_sharded(
     wall = time.perf_counter() - t0
     overflow = bool(np.asarray(fr.overflow).any())
     proven = int(total[0]) == 0 and not overflow
+    if checkpoint_path and not proven:
+        save(checkpoint_path, fr, ic, itour, d=d, bound=bound,
+             num_ranks=num_ranks)
     return BnBResult(
         cost=float(ic[0]),
         tour=np.asarray(itour)[0],
@@ -797,6 +986,7 @@ def solve_sharded(
         nodes_per_sec=nodes / wall if wall > 0 else 0.0,
         time_to_best=t_best,
         root_lower_bound=root_lb,
+        nodes_per_rank=rank_nodes,
     )
 
 
@@ -810,8 +1000,23 @@ def _d_fingerprint(d) -> np.ndarray:
     return np.asarray([d.shape[0], float(d.sum()), float(d.std())])
 
 
-def save(path: str, fr: Frontier, inc_cost, inc_tour, d=None, bound=None) -> None:
-    """Checkpoint frontier + incumbent (+ instance fingerprint) to ``.npz``."""
+def save(
+    path: str,
+    fr: Frontier,
+    inc_cost,
+    inc_tour,
+    d=None,
+    bound=None,
+    reservoir=None,
+    num_ranks: Optional[int] = None,
+) -> None:
+    """Checkpoint frontier + incumbent (+ instance fingerprint + any
+    host-spilled reservoir nodes) to ``.npz``.
+
+    ``num_ranks``: set for a sharded checkpoint (stacked [R, ...] frontier
+    arrays); restore() then refuses to resume it on a different rank count
+    (per-rank stacks can't be re-split without re-sorting the search order).
+    """
     payload = {
         "inc_cost": np.asarray(inc_cost),
         "inc_tour": np.asarray(inc_tour),
@@ -821,16 +1026,38 @@ def save(path: str, fr: Frontier, inc_cost, inc_tour, d=None, bound=None) -> Non
         payload["d_fingerprint"] = _d_fingerprint(d)
     if bound is not None:
         payload["bound_mode"] = np.asarray(bound)
+    if num_ranks is not None:
+        payload["num_ranks"] = np.asarray(num_ranks)
+    if reservoir is not None and len(reservoir):
+        for f in _Reservoir._ARRAYS:
+            payload[f"res_{f}"] = np.concatenate(
+                [c[f] for c in reservoir.chunks]
+            )
     np.savez_compressed(_norm_ckpt_path(path), **payload)
 
 
 def restore(
-    path: str, expect_d=None, expect_bound=None
-) -> Tuple[Frontier, jnp.ndarray, jnp.ndarray]:
+    path: str, expect_d=None, expect_bound=None, expect_ranks: Optional[int] = None
+) -> Tuple[Frontier, jnp.ndarray, jnp.ndarray, "_Reservoir"]:
     """Load a checkpoint; refuses one written for a different instance or
-    (the frontier's carried sums are bound-specific) a different bound."""
+    (the frontier's carried sums are bound-specific) a different bound.
+
+    ``expect_ranks``: None for a single-device checkpoint, else the mesh
+    size a sharded checkpoint must have been written with.
+
+    Returns ``(frontier, inc_cost, inc_tour, reservoir)`` — the reservoir
+    is empty unless the checkpoint carried spilled nodes."""
     z = np.load(_norm_ckpt_path(path))
-    if z["mask"].ndim != 2:
+    saved_ranks = int(z["num_ranks"]) if "num_ranks" in z else None
+    if saved_ranks != expect_ranks:
+        raise ValueError(
+            f"checkpoint {path!r} was written for "
+            f"{'a single device' if saved_ranks is None else f'{saved_ranks} ranks'}"
+            f"; cannot resume with "
+            f"{'a single device' if expect_ranks is None else f'{expect_ranks} ranks'}"
+        )
+    want_mask_dims = 2 if expect_ranks is None else 3
+    if z["mask"].ndim != want_mask_dims:
         raise ValueError(
             f"checkpoint {path!r} uses the pre-multi-word mask layout "
             "([F] uint32); it cannot be resumed by this version — rerun "
@@ -851,4 +1078,9 @@ def restore(
                 f"resume with the same bound (got {expect_bound!r})"
             )
     fr = Frontier(*(jnp.asarray(z[f]) for f in Frontier._fields))
-    return fr, jnp.asarray(z["inc_cost"]), jnp.asarray(z["inc_tour"])
+    reservoir = _Reservoir()
+    if "res_depth" in z:
+        reservoir.chunks.append(
+            {f: z[f"res_{f}"] for f in _Reservoir._ARRAYS}
+        )
+    return fr, jnp.asarray(z["inc_cost"]), jnp.asarray(z["inc_tour"]), reservoir
