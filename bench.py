@@ -8,17 +8,29 @@ Two modes, selected by ``TSP_BENCH`` (default ``pipeline``):
   in this environment at g++ -O2; identical instance because generation is
   srand(0)-deterministic). ``vs_baseline`` = baseline_ms / ours.
   Method: device pipeline in float32 (TPU speed mode) — on-device distance
-  matrix, vmapped dense Held-Karp over all 100 blocks, scan merge fold.
-  Compiled once (warmup), then median of 3 timed end-to-end executions.
+  matrix, vmapped dense Held-Karp over all 100 blocks, then the merge
+  fold. The fold defaults to the log2(B) TREE of vmapped pairwise merges
+  (fold_tours_tree — the shape of the reference's own cross-rank
+  MPI_ManualReduce; the merge operator is non-associative, so the folded
+  cost legitimately differs from the sequential within-rank fold exactly
+  as the reference's output differs across rank counts);
+  ``TSP_BENCH_FOLD=scan`` selects the sequential left fold that r01/r02
+  benches used — the emitted JSON carries a ``fold`` key so runs are
+  comparable. Compiled once (warmup), then median of 3 timed end-to-end
+  executions.
 
-- ``bnb`` — the north-star metric (BASELINE.json): B&B nodes/sec on TSPLIB
-  berlin52, solved to PROVEN optimality (cost 7542). The reference has no
-  B&B and no TSPLIB mode (SURVEY.md §0 discrepancy note), so there is no
-  reference binary to time; the baseline anchor is this engine's own
-  single-rank CPU rate x8 — a stand-in for the north star's "8-rank MPI"
-  comparison that generously assumes perfect MPI scaling
-  (BNB_CPU_8RANK_ANCHOR below, measured on this host). ``vs_baseline`` =
-  device nodes/sec / anchor. Warmup excludes compile from the timed run.
+- ``bnb`` — the north-star metric (BASELINE.json): B&B nodes/sec on a
+  TSPLIB instance solved to PROVEN optimality. Default instance: eil51
+  (426) — berlin52's Held-Karp root bound equals its optimum, so with the
+  ILS incumbent it closes at the root in 1 node and has no throughput to
+  measure; eil51's bound genuinely gaps (~422.5 vs 426), forcing a real
+  ~500k-node search. The reference has no B&B and no TSPLIB mode
+  (SURVEY.md §0 discrepancy note), so there is no reference binary to
+  time; the baseline anchor is this engine's own single-rank CPU rate
+  x8 — a stand-in for the north star's "8-rank MPI" comparison that
+  generously assumes perfect MPI scaling (BNB_CPU_8RANK_ANCHOR below,
+  measured on this host). ``vs_baseline`` = device nodes/sec / anchor.
+  Warmup excludes compile from the timed run.
 
 Compile time is excluded in both modes (the reference has no JIT; with the
 persistent compilation cache it is a one-time cost) and printed to stderr.
@@ -36,12 +48,12 @@ import numpy as np
 BASELINE_MS = 69997.0  # BASELINE.md: 16 cities/block x 100 blocks, 1 rank
 N, BLOCKS, GRID = 16, 100, 1000
 
-#: Single-rank CPU B&B nodes/sec on berlin52 (this engine, this host,
-#: k=256, proven-optimal run, compile excluded) x 8 ranks — i.e. the
-#: anchor generously assumes perfect 8-way MPI scaling of our own CPU
-#: rate. Measured 2026-07-29 (38,040 nodes/s, proof in 1.07 s); see
-#: BENCHMARKS.md for the recorded run.
-BNB_CPU_8RANK_ANCHOR = 8 * 38000.0
+#: Single-rank CPU B&B nodes/sec on eil51 (this engine, this host, k=256,
+#: proven-optimal run, compile excluded) x 8 ranks — i.e. the anchor
+#: generously assumes perfect 8-way MPI scaling of our own CPU rate.
+#: Measured 2026-07-29 (12,609 nodes/s, proof in 38.1 s at capacity 1<<17);
+#: see BENCHMARKS.md for the recorded run.
+BNB_CPU_8RANK_ANCHOR = 8 * 12609.0
 
 
 def _accelerator_usable(timeout_s: float = 180.0) -> bool:
@@ -79,7 +91,8 @@ def _accelerator_usable(timeout_s: float = 180.0) -> bool:
 
 
 def bench_bnb() -> int:
-    """North-star metric: B&B nodes/sec to proven optimality on berlin52."""
+    """North-star metric: B&B nodes/sec to proven optimality (default
+    instance eil51 — see module docstring for why not berlin52)."""
     import jax
 
     from tsp_mpi_reduction_tpu.models import branch_bound as bb
@@ -87,7 +100,7 @@ def bench_bnb() -> int:
 
     dev = jax.devices()[0]
     print(f"bench device: {dev}", file=sys.stderr)
-    name = os.environ.get("TSP_BENCH_INSTANCE", "berlin52")
+    name = os.environ.get("TSP_BENCH_INSTANCE", "eil51")
     inst = tsplib.embedded(name)
     d = inst.distance_matrix()
     k = int(os.environ.get("TSP_BENCH_K", "256"))
@@ -141,7 +154,7 @@ def main() -> int:
     from tsp_mpi_reduction_tpu.ops.distance import distance_matrix
     from tsp_mpi_reduction_tpu.ops.generator import generate_instance
     from tsp_mpi_reduction_tpu.ops.held_karp import build_plan, solve_blocks_from_dists
-    from tsp_mpi_reduction_tpu.ops.merge import fold_tours
+    from tsp_mpi_reduction_tpu.ops.merge import fold_tours, fold_tours_tree
 
     impl = os.environ.get("TSP_TPU_IMPL")  # compact|dense|fused|pallas
     if impl:
@@ -154,6 +167,11 @@ def main() -> int:
     _, xy = generate_instance(N, BLOCKS, GRID, GRID)
     xy32 = np.asarray(xy, np.float32)
 
+    # tree fold by default (log2(B) vmapped merge rounds — the reference's
+    # own cross-rank reduce shape); TSP_BENCH_FOLD=scan measures the
+    # sequential left fold for comparison
+    fold = fold_tours if os.environ.get("TSP_BENCH_FOLD") == "scan" else fold_tours_tree
+
     @jax.jit
     def step(xy_blocks):
         flat = xy_blocks.reshape(-1, 2)
@@ -161,7 +179,7 @@ def main() -> int:
         block_d = jax.vmap(distance_matrix)(xy_blocks)
         costs, local_tours = solve_blocks_from_dists(block_d, jnp.float32)
         offsets = (jnp.arange(BLOCKS, dtype=jnp.int32) * N)[:, None]
-        ids, length, cost = fold_tours(
+        ids, length, cost = fold(
             local_tours.astype(jnp.int32) + offsets, costs, dist
         )
         return cost, length
@@ -190,6 +208,7 @@ def main() -> int:
                 "value": round(value, 3),
                 "unit": "ms",
                 "vs_baseline": round(BASELINE_MS / value, 2),
+                "fold": "scan" if fold is fold_tours else "tree",
             }
         )
     )

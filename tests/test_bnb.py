@@ -176,6 +176,24 @@ def test_greedy_init_tools():
     assert sorted(improved[:-1].tolist()) == list(range(20))
 
 
+def test_stretch_200_city_one_tree_gap():
+    """BASELINE config 5 (stretch): 200-city random Euclidean + 1-tree root
+    bound. Engine runs within the raised MAX_BNB_CITIES (7 mask words),
+    yields a valid tour, a certified root bound, and a reportable gap."""
+    assert bb.MAX_BNB_CITIES >= 200
+    rng = np.random.default_rng(200)
+    xy = rng.uniform(0, 1000, (200, 2))
+    d = np.rint(np.hypot(*(xy[:, None] - xy[None, :]).transpose(2, 0, 1)))
+    res = bb.solve(d, capacity=1 << 13, k=64, inner_steps=4, time_limit_s=20)
+    tour = res.tour
+    assert tour[0] == tour[-1] == 0
+    assert sorted(tour[:-1].tolist()) == list(range(200))
+    assert res.cost == pytest.approx(bb.tour_cost(d, tour), rel=1e-6)
+    # certified bound: gap to the incumbent is finite and sane (HK 1-tree
+    # is typically within a few percent on uniform instances)
+    assert 0 <= res.cost - res.root_lower_bound <= 0.2 * res.cost
+
+
 def test_rejects_out_of_range_n():
     with pytest.raises(ValueError):
         bb.solve(np.ones((bb.MAX_BNB_CITIES + 1,) * 2))

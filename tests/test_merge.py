@@ -61,3 +61,44 @@ def test_fold_final_bit_exact(goldens_dir, name):
 def test_merge_rejects_oversized():
     with pytest.raises(ValueError):
         make_padded(np.arange(10), 10, 0.0, capacity=5)
+
+
+def _host_tree_fold(tours, costs, dist):
+    """Host mirror of fold_tours_tree's pairing, built from single
+    merge_tours calls — the tree fold must match it bit-for-bit."""
+    l = tours.shape[1]
+    cur = [
+        PaddedTour(jnp.asarray(t, jnp.int32), jnp.asarray(l, jnp.int32), c)
+        for t, c in zip(tours, costs)
+    ]
+    while len(cur) > 1:
+        out_cap = int(cur[0].ids.shape[0] + cur[1].ids.shape[0] - 1)
+        nxt = []
+        for i in range(0, len(cur) - 1, 2):
+            t1 = make_padded(
+                cur[i].ids, cur[i].length, cur[i].cost, out_cap
+            )
+            nxt.append(merge_tours(t1, cur[i + 1], dist))
+        if len(cur) % 2:
+            odd = cur[-1]
+            nxt.append(make_padded(odd.ids, odd.length, odd.cost, out_cap))
+        cur = nxt
+    return cur[0]
+
+
+@pytest.mark.parametrize("name", ["full_5x10_1000x1000.json", "full_5x50_1000x1000.json"])
+def test_tree_fold_matches_host_tree_and_is_valid(goldens_dir, name):
+    from tsp_mpi_reduction_tpu.ops.merge import fold_tours_tree
+
+    g, n, b, dist, costs, tours = setup(goldens_dir, name)
+    ids, length, cost = fold_tours_tree(jnp.asarray(tours), jnp.asarray(costs), dist)
+    ref = _host_tree_fold(tours, costs, dist)
+    assert int(length) == int(ref.length) == n * b + 1
+    np.testing.assert_array_equal(
+        np.asarray(ids)[: int(length)], np.asarray(ref.ids)[: int(length)]
+    )
+    assert float(cost) == float(ref.cost)
+    # closed tour visiting every city exactly once
+    t = np.asarray(ids)[: int(length)]
+    assert t[0] == t[-1]
+    assert sorted(t[:-1].tolist()) == list(range(n * b))

@@ -148,7 +148,15 @@ def test_native_cli_binary_reference_contract(tmp_path):
 
     r = subprocess.run([binary], capture_output=True, text=True)
     assert r.returncode == 1
-    assert r.stdout.startswith("Usage is:")
+    # byte-identical to the reference's usage line (tsp.cpp:282)
+    assert r.stdout == "Usage:  ./tsp numCitiesPerBlock numBlocks gridDimX gridDimY\n"
+
+    r = subprocess.run([binary, "17", "1", "10", "10"], capture_output=True, text=True)
+    # byte-identical reference scold (tsp.cpp:292)
+    assert r.stdout == (
+        "Come on... We don't want to wait forever so lets just have you "
+        "retry that with less than 16 cities per block...\n"
+    )
 
     r = subprocess.run([binary, "2", "4", "10", "10"], capture_output=True)
     assert r.returncode == 2  # clean error instead of the reference hang

@@ -54,6 +54,30 @@ def main() -> int:
 
     if args.instance in tsplib.EMBEDDED:
         inst = tsplib.embedded(args.instance)
+    elif args.instance.startswith("random:"):
+        # "random:N[:SEED]" — N-city uniform Euclidean instance with integer
+        # (nint) distances, e.g. the BASELINE stretch config "random:200"
+        import numpy as np
+
+        parts = args.instance.split(":")
+        try:
+            n_cities = int(parts[1])
+            seed = int(parts[2]) if len(parts) > 2 else 0
+            if n_cities < 3:
+                raise ValueError("need at least 3 cities")
+        except (ValueError, IndexError) as e:
+            print(f"error: bad random instance spec {args.instance!r}: {e}",
+                  file=sys.stderr)
+            return 2
+        rng = np.random.default_rng(seed)
+        xy = rng.uniform(0, 1000, (n_cities, 2))
+        inst = tsplib.TSPLIBInstance(
+            name=f"random{n_cities}s{seed}",
+            dimension=n_cities,
+            edge_weight_type="EUC_2D",
+            comment=f"uniform random {n_cities} cities, seed {seed}",
+            coords=xy,
+        )
     else:
         try:
             inst = tsplib.load(args.instance)

@@ -168,3 +168,60 @@ def fold_tours(
 
     acc, _ = jax.lax.scan(step, acc, (tours[1:], costs[1:]))
     return acc.ids, acc.length, acc.cost
+
+
+def fold_tours_tree(
+    tours: jnp.ndarray, costs: jnp.ndarray, dist: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Tree-shaped fold: log2(B) rounds of vmapped pairwise merges.
+
+    The speed-path alternative to ``fold_tours``'s B-1 sequential scan
+    steps: round t merges pairs (2i, 2i+1) of the surviving tours in ONE
+    vmapped kernel, halving the count — ~log2(B) kernel launches instead of
+    a B-step sequential dependency chain, which is what dominates wall
+    time on TPU (each scan step is far too small to fill the chip).
+
+    Buffers are sized per round (a round-t tour holds at most ``t1_len +
+    t2_len - 1`` cities), so early rounds stay tiny; total arithmetic
+    matches the sequential fold's O(B^2 n^2) but with B/2^t-way batch
+    parallelism per round.
+
+    The merge operator is non-associative, so the folded tour/cost differ
+    (legitimately) from the sequential fold's — the reference itself uses
+    BOTH shapes: a sequential fold within a rank (tsp.cpp:348-352) and a
+    binary tree across ranks (MPI_ManualReduce, tsp.cpp:52-134). Byte
+    parity against the oracle therefore requires ``fold_tours``; this
+    fold mirrors the reference's cross-rank tree.
+
+    Args/returns: as ``fold_tours``, except the returned ids buffer is
+    sized ``2^ceil(log2 B) * (L-1) + 1`` (capacities double per round) —
+    larger than the exact final length for non-power-of-two B. Consumers
+    must slice by the returned ``length``; entries past it are zero.
+    """
+    tours = jnp.asarray(tours, jnp.int32)
+    b, l = tours.shape
+    cur = [
+        PaddedTour(tours[i], jnp.asarray(l, jnp.int32), costs[i]) for i in range(b)
+    ]
+    vmerge = jax.vmap(merge_tours, in_axes=(0, 0, None))
+    while len(cur) > 1:
+        pairs = len(cur) // 2
+        # output buffer: every surviving tour padded to the merged size
+        out_cap = int(cur[0].ids.shape[0] + cur[1].ids.shape[0] - 1)
+        left = jax.tree.map(lambda *x: jnp.stack(x), *cur[0 : 2 * pairs : 2])
+        right = jax.tree.map(lambda *x: jnp.stack(x), *cur[1 : 2 * pairs : 2])
+        pad = out_cap - left.ids.shape[1]
+        left = PaddedTour(
+            jnp.pad(left.ids, ((0, 0), (0, pad))), left.length, left.cost
+        )
+        merged = vmerge(left, right, dist)
+        nxt = [jax.tree.map(lambda x: x[i], merged) for i in range(pairs)]
+        if len(cur) % 2:
+            odd = cur[-1]
+            opad = out_cap - int(odd.ids.shape[0])
+            nxt.append(
+                PaddedTour(jnp.pad(odd.ids, (0, opad)), odd.length, odd.cost)
+            )
+        cur = nxt
+    acc = cur[0]
+    return acc.ids, acc.length, acc.cost
