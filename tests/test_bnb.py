@@ -63,13 +63,16 @@ def test_float_slack_large_scale():
 
 def test_mst_bound_node_efficiency():
     """The per-node MST re-bound must expand far fewer nodes than the
-    incremental bound alone on the same instance."""
+    incremental bound alone on the same instance; the per-node mini-ascent
+    (extra subgradient steps) must preserve exactness and not expand more."""
     d = np.rint(random_d(13, 11) * 10)
     weak = bb.solve(d, capacity=1 << 15, k=64, mst_prune=False)
-    strong = bb.solve(d, capacity=1 << 15, k=64, mst_prune=True)
-    assert weak.proven_optimal and strong.proven_optimal
-    assert weak.cost == strong.cost
+    strong = bb.solve(d, capacity=1 << 15, k=64, mst_prune=True, node_ascent=0)
+    ascent = bb.solve(d, capacity=1 << 15, k=64, mst_prune=True, node_ascent=3)
+    assert weak.proven_optimal and strong.proven_optimal and ascent.proven_optimal
+    assert weak.cost == strong.cost == ascent.cost
     assert strong.nodes_expanded <= weak.nodes_expanded
+    assert ascent.nodes_expanded <= strong.nodes_expanded
 
 
 @pytest.mark.slow

@@ -37,6 +37,11 @@ def main() -> int:
     ap.add_argument("--checkpoint-every", type=int, default=0)
     ap.add_argument("--resume", default=None)
     ap.add_argument("--bound", default="one-tree", choices=["one-tree", "min-out"])
+    ap.add_argument(
+        "--node-ascent", type=int, default=2,
+        help="per-node mini-ascent steps on the MST bound (0 disables; "
+        "each step costs one more vmapped Prim but prunes harder)",
+    )
     args = ap.parse_args()
 
     platform = select_backend(args.backend)
@@ -97,6 +102,7 @@ def main() -> int:
             inner_steps=args.inner_steps,
             time_limit_s=args.time_limit,
             bound=args.bound,
+            node_ascent=args.node_ascent,
             checkpoint_path=args.checkpoint,
             checkpoint_every=args.checkpoint_every,
             resume_from=args.resume,
@@ -112,6 +118,7 @@ def main() -> int:
             checkpoint_every=args.checkpoint_every,
             resume_from=args.resume,
             bound=args.bound,
+            node_ascent=args.node_ascent,
         )
 
     opt = inst.known_optimum
@@ -128,6 +135,7 @@ def main() -> int:
                 "nodes_per_sec": round(res.nodes_per_sec, 1),
                 "time_to_best_s": round(res.time_to_best, 4),
                 "wall_s": round(res.wall_seconds, 3),
+                "setup_s": round(res.setup_seconds, 3),
                 "ranks": args.ranks,
                 "bound": args.bound,
                 "root_lower_bound": round(res.root_lower_bound, 3),

@@ -36,6 +36,10 @@ def main() -> int:
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--backend", default="auto", choices=["auto", "cpu", "tpu"])
     ap.add_argument("--dtype", default=None, choices=["float64", "float32"])
+    ap.add_argument(
+        "--resume", action="store_true",
+        help="append to --out, skipping configs it already contains",
+    )
     args = ap.parse_args()
 
     platform = select_backend(args.backend)
@@ -56,11 +60,43 @@ def main() -> int:
         blocks = range(10, 201, 10)
         procs = range(2, 21, 2)
 
-    with open(args.out, "w") as f:
-        f.write(reporting.CSV_HEADER + "\n")
+    # resume: skip configs already in the CSV (a full sweep is hours; the
+    # process may be restarted), identified by their first three columns
+    done = set()
+    if args.resume:
+        try:
+            with open(args.out) as f:
+                for ln in f:
+                    parts = ln.strip().split(",")
+                    if len(parts) == 5 and parts[0].isdigit():
+                        done.add((int(parts[0]), int(parts[1]), int(parts[2])))
+        except OSError:
+            pass
+
+    mode = "a" if (args.resume and done) else "w"
+    # a killed sweep can leave a partial (unterminated) last line — appending
+    # straight onto it would corrupt the row; terminate it first. The partial
+    # row was never counted as done (it doesn't parse as 5 fields), so its
+    # config reruns.
+    needs_nl = False
+    if mode == "a":
+        try:
+            with open(args.out, "rb") as f:
+                f.seek(-1, 2)
+                needs_nl = f.read(1) != b"\n"
+        except OSError:
+            pass
+    since_clear = 0
+    with open(args.out, mode) as f:
+        if mode == "w":
+            f.write(reporting.CSV_HEADER + "\n")
+        elif needs_nl:
+            f.write("\n")
         for n in cities:
             for nb in blocks:
                 for p in procs:
+                    if (n, nb, p) in done:
+                        continue
                     t0 = time.perf_counter()
                     res = run_pipeline_ranks(n, nb, args.grid, args.grid, p, dtype=dtype)
                     ms = int((time.perf_counter() - t0) * 1000)
@@ -68,6 +104,13 @@ def main() -> int:
                     print(row)
                     f.write(row + "\n")
                     f.flush()
+                    # every distinct (n, nb, p) shape compiles a fresh XLA
+                    # program; dropping the caches periodically keeps a
+                    # 1200-config sweep from exhausting host memory
+                    since_clear += 1
+                    if since_clear >= 40:
+                        jax.clear_caches()
+                        since_clear = 0
     return 0
 
 

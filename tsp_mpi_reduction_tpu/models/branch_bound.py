@@ -82,6 +82,11 @@ class BnBResult:
     root_lower_bound: float = -np.inf
     #: per-rank expansion counts (solve_sharded only) — load-balance evidence
     nodes_per_rank: Optional[np.ndarray] = None
+    #: seconds spent before the search loop (bound setup + incumbent/ILS
+    #: construction) — wall_seconds/time_to_best measure the search only,
+    #: so an incumbent found during setup shows time_to_best=0 and this
+    #: field carries the honest cost of getting it
+    setup_seconds: float = 0.0
 
 
 def nearest_neighbor_tour(d: np.ndarray, start: int = 0) -> np.ndarray:
@@ -215,11 +220,15 @@ class BoundData(NamedTuple):
     dbar: jnp.ndarray  # [n, n] f32 reduced metric d + pi_i + pi_j (MST bound)
     pi: jnp.ndarray  # [n] f32 potentials (zeros in min-out mode)
     slack: jnp.ndarray  # scalar f32 rounding slack for the MST bound (0 if exact)
+    ascent_step: jnp.ndarray  # scalar f32 per-node mini-ascent step (grid multiple)
+    lam_budget: jnp.ndarray  # scalar f32 clamp on per-node ascent deltas
     root_lb: float  # certified global lower bound (f64-evaluated)
     integral: bool  # metric is integer-valued; bounds are fixed-point exact
 
 
-def _bound_setup(d, bound: str, ascent_steps: int = 400) -> BoundData:
+def _bound_setup(
+    d, bound: str, ascent_steps: int = 400, node_ascent: int = 0
+) -> BoundData:
     """Build the bound machinery for a metric + bound mode -> ``BoundData``.
 
     "min-out": pi = 0 — weights are the plain cheapest outgoing edge.
@@ -267,7 +276,16 @@ def _bound_setup(d, bound: str, ascent_steps: int = 400) -> BoundData:
     # MST sums over the reduced metric, carried weight sums, pi corrections
     max_d = float(np.abs(d64).max())
     max_pi = float(np.abs(pi64).max())
-    mag = n * (max_d + 4.0 * max_pi) + 4.0 * float(np.abs(pi64).sum()) + 1.0
+    # the + 2*n*max_d term covers per-node mini-ascent lambda drift: lam is
+    # clamped to +-max_d/4 (lam_budget below), so each of <= n+1 structure
+    # edges shifts by <= max_d/2 and the degree-weighted correction by
+    # <= 2(n+1)*max_d/4 — both under n*max_d apiece
+    mag = (
+        n * (max_d + 4.0 * max_pi)
+        + 4.0 * float(np.abs(pi64).sum())
+        + 2.0 * n * max_d
+        + 1.0
+    )
 
     # a negative grid exponent would make the grid coarser than 1, so integer
     # distances would no longer be exact grid multiples — fall back to the
@@ -281,7 +299,9 @@ def _bound_setup(d, bound: str, ascent_steps: int = 400) -> BoundData:
         pi64 = np.round(pi64 / grid) * grid
         slack = 0.0
     else:
-        slack = 3.0 * n * float(np.spacing(np.float32(mag)))
+        # sized for ~3n ops per bound chain, plus one more full Prim chain
+        # (~3n ops) per mini-ascent step actually requested
+        slack = 3.0 * (1 + node_ascent) * n * float(np.spacing(np.float32(mag)))
 
     # derive everything from the (possibly quantized) pi in f64: for the
     # integral path all results are exact grid multiples, hence exact in f32
@@ -302,15 +322,90 @@ def _bound_setup(d, bound: str, ascent_steps: int = 400) -> BoundData:
     else:
         root_lb = root_lb - slack
         adj = adj - slack
+    # per-node mini-ascent step + lambda clamp: small fractions of the edge
+    # scale; snapped to grid multiples on the integral path so lambda stays
+    # exact (the clamp also guarantees the mag headroom above)
+    raw_step = max_d / (8.0 * n)
+    lam_budget = max_d / 4.0
+    if integral:
+        raw_step = max(grid, np.floor(raw_step / grid) * grid)
+        lam_budget = max(grid, np.floor(lam_budget / grid) * grid)
     return BoundData(
         jnp.asarray(w, jnp.float32),
         jnp.asarray(adj, jnp.float32),
         jnp.asarray(dbar64, jnp.float32),
         jnp.asarray(pi64, jnp.float32),
         jnp.asarray(slack, jnp.float32),
+        jnp.asarray(raw_step, jnp.float32),
+        jnp.asarray(lam_budget, jnp.float32),
         root_lb,
         integral,
     )
+
+
+def _mst_conn(dbar, unvis, cur, n, lam=None):
+    """One MST(U) + connection-edges evaluation -> (value, degrees).
+
+    ``lam``: optional [k, n] per-node potential deltas added edge-wise
+    (lam_i + lam_j) on top of ``dbar`` — used by the per-node mini-ascent.
+    Degrees count MST + connection edges per vertex (cur/0 included), the
+    subgradient of the path relaxation (targets: cur/0 -> 1, U -> 2).
+    """
+    big = jnp.asarray(jnp.inf, dbar.dtype)
+    k = unvis.shape[0]
+    lanes = jnp.arange(k)
+
+    def edge_rows(u):  # [k, n] reduced costs from each lane's vertex u
+        base = dbar[u]
+        if lam is None:
+            return base
+        return base + jnp.take_along_axis(lam, u[:, None], axis=1) + lam
+
+    start = jnp.argmax(unvis, axis=1)
+    intree0 = jnp.zeros((k, n), bool).at[lanes, start].set(True)
+    mind0 = jnp.where(unvis, edge_rows(start), big)
+    closest0 = jnp.broadcast_to(start[:, None], (k, n))
+    # zero carries derived from varying inputs so their varying-axis types
+    # match the body outputs under shard_map
+    deg0 = (unvis * 0).astype(jnp.int32)
+
+    def body(_, carry):
+        intree, mind, closest, deg, tot = carry
+        cand = jnp.where(intree, big, mind)
+        u = jnp.argmin(cand, axis=1)
+        wu = jnp.take_along_axis(cand, u[:, None], axis=1)[:, 0]
+        fin = jnp.isfinite(wu)
+        tot = tot + jnp.where(fin, wu, 0.0)
+        par = jnp.take_along_axis(closest, u[:, None], axis=1)[:, 0]
+        one = fin.astype(jnp.int32)
+        deg = deg.at[lanes, u].add(one).at[lanes, par].add(one)
+        intree = intree.at[lanes, u].set(True)
+        row = jnp.where(unvis, edge_rows(u), big)
+        better = row < mind
+        closest = jnp.where(better, u[:, None], closest)
+        mind = jnp.minimum(mind, row)
+        return intree, mind, closest, deg, tot
+
+    zero = (cur * 0).astype(dbar.dtype)
+    _, _, _, deg, mst = jax.lax.fori_loop(
+        0, n - 1, body, (intree0, mind0, closest0, deg0, zero)
+    )
+
+    row_cur = jnp.where(unvis, edge_rows(cur), big)
+    row_0 = jnp.where(unvis, edge_rows(jnp.zeros_like(cur)), big)
+    a_cur = jnp.argmin(row_cur, axis=1)
+    min_cur = jnp.take_along_axis(row_cur, a_cur[:, None], axis=1)[:, 0]
+    neg2, idx2 = jax.lax.top_k(-row_0, 2)
+    is_root = cur == 0
+    conn = jnp.where(is_root, -neg2[:, 0] - neg2[:, 1], min_cur + (-neg2[:, 0]))
+    conn = jnp.where(jnp.isfinite(conn), conn, big)
+    # connection-edge degree bumps
+    one = jnp.ones_like(cur)
+    deg = deg.at[lanes, jnp.where(is_root, idx2[:, 1], a_cur)].add(1)
+    deg = deg.at[lanes, idx2[:, 0]].add(1)
+    deg = deg.at[lanes, jnp.where(is_root, 0 * one, cur)].add(1)
+    deg = deg.at[lanes, 0 * one].add(1)
+    return mst + conn, deg
 
 
 def _batched_mst_bound(
@@ -320,6 +415,9 @@ def _batched_mst_bound(
     cur: jnp.ndarray,
     p_cost: jnp.ndarray,
     n: int,
+    node_ascent: int = 0,
+    ascent_step=None,
+    lam_budget=None,
 ):
     """Reduced-cost MST + connection-edges lower bound for a batch of nodes.
 
@@ -338,59 +436,59 @@ def _batched_mst_bound(
 
         prefix_cost + MST_dbar(U) + conn - pi[cur] - pi[0] - 2*sum(pi[U]).
 
+    ``node_ascent > 0`` adds that many per-node subgradient steps on TOP of
+    the global potentials: per-lane deltas ``lam`` move along
+    ``deg - target`` (targets: cur/0 -> 1, U -> 2; the relaxation is valid
+    for ARBITRARY potentials, so any lam yields a certified bound and the
+    best over steps is kept). Each step costs one more vmapped Prim.
+
     This is typically FAR stronger than the incremental min-out sum, at the
-    cost of a vmapped dense Prim (n-1 fori steps over [k, n] lanes — tiny
+    cost of vmapped dense Prims (n-1 fori steps over [k, n] lanes — tiny
     per-step work that pipelines fine under the inner while_loop). With
-    quantized pi (_bound_setup) every value is fixed-point-exact in f32, so
-    the bound certifies pruning with no slack.
+    quantized pi and a grid-multiple ``ascent_step`` (_bound_setup) every
+    value is fixed-point-exact in f32, so the bound certifies pruning with
+    no slack.
     """
-    big = jnp.asarray(jnp.inf, dbar.dtype)
     k = unvis.shape[0]
     lanes = jnp.arange(k)
+    big = jnp.asarray(jnp.inf, dbar.dtype)
 
-    # Prim over U, rooted at each lane's first unvisited vertex
-    start = jnp.argmax(unvis, axis=1)  # first True (garbage if U empty; masked)
-    init_intree = jnp.zeros((k, n), bool).at[lanes, start].set(True)
-    init_mind = jnp.where(unvis, dbar[start], big)  # [k, n]
-
-    def body(_, carry):
-        intree, mind, tot = carry
-        cand = jnp.where(intree, big, mind)  # [k, n]
-        u = jnp.argmin(cand, axis=1)  # [k]
-        wu = jnp.take_along_axis(cand, u[:, None], axis=1)[:, 0]
-        fin = jnp.isfinite(wu)
-        tot = tot + jnp.where(fin, wu, 0.0)
-        intree = intree.at[lanes, u].set(True)
-        mind = jnp.minimum(mind, jnp.where(unvis, dbar[u], big))
-        return intree, mind, tot
-
-    # zero carry derived from p_cost so its varying-axis type matches the
-    # body outputs under shard_map (same trick as _expand_loop's carries)
-    _, _, mst = jax.lax.fori_loop(
-        0, n - 1, body, (init_intree, init_mind, (p_cost * 0).astype(dbar.dtype))
-    )
-
-    # connection edges: cheapest cur->U and cheapest 0->U; at the root
-    # (cur == 0) both come from row 0, which must then supply its TWO
-    # cheapest edges — the 1-tree construction
-    row_cur = jnp.where(unvis, dbar[cur], big)  # [k, n]
-    row_0 = jnp.where(unvis, dbar[0][None, :], big)  # [k, n]
-    min_cur = row_cur.min(axis=1)
-    neg2, _ = jax.lax.top_k(-row_0, 2)  # two smallest of row 0
-    conn = jnp.where(
-        cur == 0,
-        -neg2[:, 0] - neg2[:, 1],
-        min_cur + row_0.min(axis=1),
-    )
-    # |U| == 1 with cur == 0 (n == 2 only): top_k would double-count the
-    # single edge; unreachable since solve() requires n >= 3
-    conn = jnp.where(jnp.isfinite(conn), conn, big)
-
+    val, deg = _mst_conn(dbar, unvis, cur, n)
+    val = jnp.where(jnp.isfinite(val), val, big)
     sum_pi_u = jnp.sum(jnp.where(unvis, pi[None, :], 0.0), axis=1)
-    return p_cost + mst + conn - pi[cur] - pi[0] - 2.0 * sum_pi_u
+    best = p_cost + val - pi[cur] - pi[0] - 2.0 * sum_pi_u
+
+    if node_ascent > 0:
+        cities = jnp.arange(n, dtype=cur.dtype)
+        icur = cities[None, :] == cur[:, None]
+        i0 = cities[None, :] == 0
+        in_s = unvis | icur | i0
+        # degree targets: U -> 2, endpoints -> 1 (cur==0 lanes: 0 -> 2,
+        # which icur+i0 double-counting yields automatically)
+        target = 2 * unvis.astype(jnp.int32) + icur.astype(jnp.int32) + i0.astype(jnp.int32)
+        lam = jnp.zeros((k, n), dbar.dtype) + (p_cost[:, None] * 0)
+        step = jnp.asarray(ascent_step, dbar.dtype)
+        budget = jnp.asarray(lam_budget, dbar.dtype)
+        for _ in range(node_ascent):
+            g = jnp.where(in_s, deg - target, 0).astype(dbar.dtype)
+            # the clamp bounds lambda drift to the magnitude headroom
+            # budgeted in _bound_setup (any clamped lam is still a valid
+            # potential, so the bound stays certified)
+            lam = jnp.clip(lam + step * g, -budget, budget)
+            val, deg = _mst_conn(dbar, unvis, cur, n, lam)
+            val = jnp.where(jnp.isfinite(val), val, big)
+            lam_cur = jnp.take_along_axis(lam, cur[:, None].astype(jnp.int32), axis=1)[:, 0]
+            corr = (
+                pi[cur] + lam_cur + pi[0] + lam[:, 0]
+                + 2.0 * (sum_pi_u + jnp.sum(jnp.where(unvis, lam, 0.0), axis=1))
+            )
+            best = jnp.maximum(best, p_cost + val - corr)
+    return best
 
 
-@partial(jax.jit, static_argnames=("k", "n", "integral", "use_mst"))
+@partial(
+    jax.jit, static_argnames=("k", "n", "integral", "use_mst", "node_ascent")
+)
 def _expand_step(
     fr: Frontier,
     inc_cost: jnp.ndarray,
@@ -401,10 +499,13 @@ def _expand_step(
     dbar: jnp.ndarray,
     pi: jnp.ndarray,
     mst_slack: jnp.ndarray,
+    ascent_step: jnp.ndarray,
+    lam_budget: jnp.ndarray,
     k: int,
     n: int,
     integral: bool = False,
     use_mst: bool = True,
+    node_ascent: int = 0,
 ):
     """Pop <=K nodes, expand, prune, push. Returns (frontier', inc', stats).
 
@@ -448,7 +549,13 @@ def _expand_step(
         # the full rounding slack comes off the strong bound itself (it must
         # cover the prefix-cost accumulation too, not just the MST edges);
         # zero on the fixed-point-exact integral path
-        strong = _batched_mst_bound(dbar, pi, unvis, cur, p_cost, n) - mst_slack
+        strong = (
+            _batched_mst_bound(
+                dbar, pi, unvis, cur, p_cost, n, node_ascent, ascent_step,
+                lam_budget
+            )
+            - mst_slack
+        )
         if integral:
             live = live & (strong <= inc_cost - 1.0)
         else:
@@ -532,7 +639,8 @@ def _expand_step(
 
 
 @partial(
-    jax.jit, static_argnames=("k", "n", "inner_steps", "integral", "use_mst")
+    jax.jit,
+    static_argnames=("k", "n", "inner_steps", "integral", "use_mst", "node_ascent"),
 )
 def _expand_loop(
     fr: Frontier,
@@ -544,11 +652,14 @@ def _expand_loop(
     dbar: jnp.ndarray,
     pi: jnp.ndarray,
     mst_slack: jnp.ndarray,
+    ascent_step: jnp.ndarray,
+    lam_budget: jnp.ndarray,
     k: int,
     n: int,
     inner_steps: int,
     integral: bool = False,
     use_mst: bool = True,
+    node_ascent: int = 0,
 ):
     """Run up to ``inner_steps`` expansion steps in ONE device program.
 
@@ -563,8 +674,8 @@ def _expand_loop(
     def body(carry):
         fr, ic, itour, nodes, i = carry
         fr, ic, itour, stats = _expand_step(
-            fr, ic, itour, d, min_out, bound_adj, dbar, pi, mst_slack, k, n,
-            integral, use_mst
+            fr, ic, itour, d, min_out, bound_adj, dbar, pi, mst_slack,
+            ascent_step, lam_budget, k, n, integral, use_mst, node_ascent
         )
         return fr, ic, itour, nodes + stats["popped"], i + 1
 
@@ -689,6 +800,7 @@ def solve(
     bound: str = "one-tree",
     mst_prune: bool = True,
     ils_rounds: Optional[int] = None,
+    node_ascent: int = 2,
 ) -> BnBResult:
     """Exact B&B on one device. ``d`` is a dense [n, n] distance matrix.
 
@@ -702,6 +814,7 @@ def solve(
     Stops when the frontier empties (proven optimal), or at
     ``max_iters``/``time_limit_s``/``target_cost`` (then best-so-far).
     """
+    t_setup = time.perf_counter()
     n = d.shape[0]
     if not 3 <= n <= MAX_BNB_CITIES:
         # ceil(MAX_BNB_CITIES/32) mask words; 1-tree needs >= 3 vertices
@@ -709,7 +822,7 @@ def solve(
             f"B&B engine supports 3 <= n <= {MAX_BNB_CITIES} cities, got {n}"
         )
     d32 = jnp.asarray(d, jnp.float32)
-    bd = _bound_setup(d, bound)
+    bd = _bound_setup(d, bound, node_ascent=node_ascent)
     min_out, bound_adj, root_lb, integral = bd.min_out, bd.bound_adj, bd.root_lb, bd.integral
     min_out_np = np.asarray(min_out, np.float64)
 
@@ -737,6 +850,7 @@ def solve(
     # small capacities fall back to keeping the top half
     headroom = min(capacity // 2, max(1, inner_steps) * k * (n - 1))
     t0 = time.perf_counter()
+    setup_s = t0 - t_setup
     t_best = 0.0
     last_inc = float(inc_cost)
     nodes = 0
@@ -745,7 +859,8 @@ def solve(
     while it < max_iters:
         fr, inc_cost, inc_tour, popped = _expand_loop(
             fr, inc_cost, inc_tour, d32, min_out, bound_adj, bd.dbar, bd.pi,
-            bd.slack, k, n, inner, integral, mst_prune
+            bd.slack, bd.ascent_step, bd.lam_budget, k, n, inner, integral,
+            mst_prune, node_ascent
         )
         nodes += int(popped)
         it += inner
@@ -789,6 +904,7 @@ def solve(
         nodes_per_sec=nodes / wall if wall > 0 else 0.0,
         time_to_best=t_best,
         root_lower_bound=root_lb,
+        setup_seconds=setup_s,
     )
 
 
@@ -808,6 +924,7 @@ def solve_sharded(
     checkpoint_every: int = 0,
     resume_from: Optional[str] = None,
     ils_rounds: Optional[int] = None,
+    node_ascent: int = 2,
 ) -> BnBResult:
     """Mesh-parallel B&B: per-rank frontiers, collective incumbent sharing.
 
@@ -830,6 +947,7 @@ def solve_sharded(
     ranks; "single-rank" piles them all on rank 0 — the adversarial case
     used to test that balancing works.
     """
+    t_setup = time.perf_counter()
     from jax import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -843,7 +961,7 @@ def solve_sharded(
     num_ranks = int(mesh.devices.size)
     d32 = jnp.asarray(d, jnp.float32)
     d_np = np.asarray(d, np.float64)
-    bd = _bound_setup(d, bound)
+    bd = _bound_setup(d, bound, node_ascent=node_ascent)
     min_out, bound_adj, root_lb, integral = bd.min_out, bd.bound_adj, bd.root_lb, bd.integral
     min_out_np = np.asarray(min_out, np.float64)
 
@@ -931,11 +1049,12 @@ def solve_sharded(
         return Frontier(count=base + m_in, overflow=f2.overflow, **out)
 
     def rank_body(fr_stacked, ic_l, itour_l, d_rep, mo_rep, ba_rep, dbar_rep,
-                  pi_rep, slack_rep):
+                  pi_rep, slack_rep, step_rep, budget_rep):
         local = Frontier(*(x[0] for x in fr_stacked))
         f2, c2, t2, nodes = _expand_loop(
             local, ic_l[0], itour_l[0], d_rep, mo_rep, ba_rep, dbar_rep,
-            pi_rep, slack_rep, k, n, inner_steps, integral, mst_prune
+            pi_rep, slack_rep, step_rep, budget_rep, k, n, inner_steps,
+            integral, mst_prune, node_ascent
         )
         if num_ranks > 1:
             f2 = ring_balance(f2)
@@ -968,6 +1087,8 @@ def solve_sharded(
                 P(None, None),
                 P(None),
                 P(),
+                P(),
+                P(),
             ),
             out_specs=(
                 tuple(P(RANK_AXIS) for _ in Frontier._fields),
@@ -981,6 +1102,7 @@ def solve_sharded(
     )
 
     t0 = time.perf_counter()
+    setup_s = t0 - t_setup
     t_best = 0.0
     last_inc = inc_cost0
     nodes = 0
@@ -988,7 +1110,7 @@ def solve_sharded(
     rank_nodes = np.zeros(num_ranks, np.int64)
     while it < max_iters:
         out = step(tuple(fr), ic, itour, d32, min_out, bound_adj, bd.dbar,
-                   bd.pi, bd.slack)
+                   bd.pi, bd.slack, bd.ascent_step, bd.lam_budget)
         fr = Frontier(*out[0])
         ic, itour, total, step_nodes = out[1], out[2], out[3], out[4]
         rank_nodes = rank_nodes + np.asarray(out[5][0])
@@ -1026,6 +1148,7 @@ def solve_sharded(
         time_to_best=t_best,
         root_lower_bound=root_lb,
         nodes_per_rank=rank_nodes,
+        setup_seconds=setup_s,
     )
 
 
