@@ -51,9 +51,9 @@ N, BLOCKS, GRID = 16, 100, 1000
 #: Single-rank CPU B&B nodes/sec on eil51 (this engine, this host, k=256,
 #: proven-optimal run, compile excluded) x 8 ranks — i.e. the anchor
 #: generously assumes perfect 8-way MPI scaling of our own CPU rate.
-#: Measured 2026-07-29 (12,609 nodes/s, proof in 38.1 s at capacity 1<<17);
-#: see BENCHMARKS.md for the recorded run.
-BNB_CPU_8RANK_ANCHOR = 8 * 12609.0
+#: Measured 2026-07-30 at the default engine config (node_ascent=2):
+#: 7,730 nodes/s, proof in 28.1 s at capacity 1<<17; see BENCHMARKS.md.
+BNB_CPU_8RANK_ANCHOR = 8 * 7730.0
 
 
 def _accelerator_usable(timeout_s: float = 180.0) -> bool:

@@ -148,3 +148,156 @@ def test_initialize_multihost_single_process():
         cwd=str(pathlib.Path(__file__).resolve().parent.parent),
     )
     assert "multihost-ok" in out.stdout, (out.stdout, out.stderr)
+
+
+# --- --compat-bugs: quirk #5 byte-parity emulation ---
+
+
+def _ref_merge_blocks(t1, c1, t2, c2, d):
+    """Literal host simulation of the reference's mergeBlocks semantics
+    (tsp.cpp:197-269), built on Python lists + rotation exactly as the
+    C++ operates on vectors — an implementation path independent of
+    ops.merge. Closed tours in, closed tour out; formulaic cost."""
+    cities1, cities2 = list(t1), list(t2)
+    n1, n2 = len(cities1), len(cities2)
+    best = None
+    # double rotate scan: i-major over tour1 positions, j-minor over tour2
+    for i in range(n1):
+        a = cities1[i]
+        b = cities1[(i + 1) % n1]
+        for j in range(n2):
+            r1 = cities2[j]
+            r2 = cities2[(j + 1) % n2]
+            sc = ((d[a, r2] + d[b, r1]) - d[a, b]) - d[r1, r2]
+            if best is None or sc < best[0]:
+                best = (sc, a, b, r1, r2)
+    sc, a, b, r1, r2 = best
+    work2 = cities2[:-1]  # pop the closing duplicate
+    # rotate until the head VALUE equals the chosen right-edge HEAD
+    # (bestSwapEdges.second.first, tsp.cpp:236-239), then ONE more rotation
+    # (tsp.cpp:242); a missing value would hang the real reference
+    if r1 not in work2:
+        raise RuntimeError("reference would hang here (quirk #6 mechanism)")
+    while work2[0] != r1:
+        work2 = work2[1:] + work2[:1]
+    work2 = work2[1:] + work2[:1]
+    out = []
+    placed = False
+    for c in cities1:
+        out.append(c)
+        if not placed and (c == a or c == b):
+            out.extend(reversed(work2))
+            placed = True
+    return out, (c1 + c2) + sc
+
+
+def _ref_buggy_reduce(rank_tours, rank_costs, d):
+    """Literal simulation of MPI_ManualReduce incl. the never-cleared
+    receive vector (tsp.cpp:67,93-95,114-117)."""
+    p = len(rank_tours)
+    sol = [list(t) for t in rank_tours]
+    cost = list(rank_costs)
+    accum = [[] for _ in range(p)]
+    for _name, pairs in tree_schedule(p):
+        for s, r in pairs:
+            accum[r] = accum[r] + sol[s]
+            sol[r], cost[r] = _ref_merge_blocks(
+                sol[r], cost[r], accum[r], cost[s], d
+            )
+    return sol[0], cost[0]
+
+
+@pytest.mark.parametrize("p", [4, 8])
+def test_compat_bugs_matches_literal_reference_simulation(p):
+    """compat_bugs=True must reproduce, value-for-value, a literal host
+    simulation of the reference's corrupted reduce (quirk #5) — the
+    closest available stand-in for a real p-rank MPI golden (no MPI
+    toolchain exists in this environment)."""
+    from tsp_mpi_reduction_tpu.parallel.reduce import (
+        compat_capacity,
+        tree_reduce_single_device,
+    )
+
+    n, nb = 4, 8
+    _, xy = generate_instance(n, nb, 300, 300)
+    d = distance_matrix_np(xy.reshape(-1, 2))
+    costs, local = solve_blocks_from_dists(distance_matrix_np(xy))
+    gtours = np.asarray(local) + (np.arange(nb)[:, None] * n)
+    costs = np.asarray(costs)
+
+    # per-rank sequential folds (reference local fold; clean — the bug is
+    # only in the reduce). Build via the literal merge too.
+    rank_blocks = assign_blocks_to_ranks(nb, p)
+    rank_tours, rank_costs = [], []
+    for blocks in rank_blocks:
+        if not blocks:
+            rank_tours.append([])
+            rank_costs.append(0.0)
+            continue
+        t, c = list(gtours[blocks[0]]), float(costs[blocks[0]])
+        for bidx in blocks[1:]:
+            t, c = _ref_merge_blocks(t, c, list(gtours[bidx]), float(costs[bidx]), d)
+        rank_tours.append(t)
+        rank_costs.append(c)
+    want_tour, want_cost = _ref_buggy_reduce(rank_tours, rank_costs, d)
+
+    # device emulation: blocks laid out per rank with padding slots
+    counts = rank_block_counts(nb, p)
+    k = max(counts) if max(counts) else 1
+    slot_tours = np.zeros((p * k, n + 1), np.int32)
+    slot_costs = np.zeros(p * k, np.float64)
+    slot_valid = np.zeros(p * k, bool)
+    for r, blocks in enumerate(rank_blocks):
+        for i, bidx in enumerate(blocks):
+            slot_tours[r * k + i] = gtours[bidx]
+            slot_costs[r * k + i] = costs[bidx]
+            slot_valid[r * k + i] = True
+    cap = compat_capacity(nb, n, p)
+    ids, length, cost = tree_reduce_single_device(
+        jnp.asarray(slot_tours),
+        jnp.asarray(slot_costs),
+        jnp.asarray(slot_valid),
+        jnp.asarray(d),
+        cap,
+        p,
+        compat_bugs=True,
+    )
+    assert float(cost) == pytest.approx(want_cost, rel=1e-12)
+    assert np.asarray(ids)[: int(length)].tolist() == want_tour
+
+
+def test_merge_parity_on_corrupted_operands_fuzz():
+    """Bit parity of merge_tours vs the literal reference simulation on
+    CORRUPTED (duplicate-id, concatenated) second operands — the regime
+    --compat-bugs exercises, including argmins landing on the wrap edge."""
+    rng = np.random.default_rng(0)
+    checked = 0
+    for seed in range(40):
+        n_ids = 7
+        d = np.rint(
+            distance_matrix_np(rng.uniform(0, 50, (n_ids, 2)))
+        )
+        l1 = int(rng.integers(3, 6))
+        t1_open = rng.permutation(n_ids)[:l1]
+        t1 = np.concatenate([t1_open, t1_open[:1]])
+        # corrupted operand: concatenation of two closed sub-tours
+        a = rng.permutation(n_ids)[: int(rng.integers(3, 5))]
+        b = rng.permutation(n_ids)[: int(rng.integers(3, 5))]
+        t2 = np.concatenate([a, a[:1], b, b[:1]])
+        try:
+            want_tour, want_cost = _ref_merge_blocks(
+                list(t1), 10.0, list(t2), 20.0, d
+            )
+        except RuntimeError:
+            continue  # real reference would hang on this operand
+        cap = len(t1) + len(t2) + 4
+        m = merge_tours(
+            make_padded(t1, len(t1), 10.0, cap),
+            make_padded(t2, len(t2), 20.0, cap),
+            jnp.asarray(d),
+        )
+        got = np.asarray(m.ids)[: int(m.length)].tolist()
+        assert got == want_tour, f"seed {seed}: {got} != {want_tour}"
+        assert float(m.cost) == pytest.approx(want_cost, rel=1e-12)
+        checked += 1
+    assert checked >= 20  # the fuzz actually exercised real cases

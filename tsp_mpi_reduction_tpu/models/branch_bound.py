@@ -450,7 +450,6 @@ def _batched_mst_bound(
     no slack.
     """
     k = unvis.shape[0]
-    lanes = jnp.arange(k)
     big = jnp.asarray(jnp.inf, dbar.dtype)
 
     val, deg = _mst_conn(dbar, unvis, cur, n)

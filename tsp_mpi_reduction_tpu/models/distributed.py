@@ -132,13 +132,17 @@ def run_pipeline_sharded(
     )
 
 
-@partial(jax.jit, static_argnames=("num_ranks", "capacity", "dtype"))
-def _emulated_step(block_d, block_offsets, valid, dist, num_ranks, capacity, dtype):
+@partial(jax.jit, static_argnames=("num_ranks", "capacity", "dtype", "compat_bugs"))
+def _emulated_step(
+    block_d, block_offsets, valid, dist, num_ranks, capacity, dtype,
+    compat_bugs=False,
+):
     costs, local_tours = solve_blocks_from_dists(block_d, dtype)
     global_tours = local_tours.astype(jnp.int32) + block_offsets[:, None]
     costs = jnp.where(valid, costs, jnp.asarray(0, costs.dtype))
     ids, length, cost = tree_reduce_single_device(
-        global_tours, costs, valid, dist, capacity, num_ranks
+        global_tours, costs, valid, dist, capacity, num_ranks,
+        compat_bugs=compat_bugs,
     )
     return costs, ids, length, cost
 
@@ -152,6 +156,7 @@ def run_pipeline_ranks(
     seed: int = 0,
     dtype=jnp.float64,
     xy: Optional[np.ndarray] = None,
+    compat_bugs: bool = False,
 ) -> PipelineResult:
     """Rank-emulated multi-rank run on a single device.
 
@@ -159,6 +164,10 @@ def run_pipeline_ranks(
     devices computes (same assignment, same tree order), without needing the
     devices — the CLI's ``--ranks`` path and the sweep harness's
     ``numProcs`` axis both use this.
+
+    ``compat_bugs``: replicate the reference's reduce-side corruption
+    (SURVEY.md quirk #5) so the result matches a real p-rank MPI run of the
+    unmodified reference bit-for-bit; see parallel.reduce.
     """
     n = num_cities_per_block
     if n < 3:
@@ -177,11 +186,17 @@ def run_pipeline_ranks(
     safe, valid = _rank_block_layout(num_blocks, num_ranks)
     block_d = jnp.asarray(block_distance_slices(dist, num_blocks, n))[safe]
     offsets = jnp.asarray(safe * n, jnp.int32)
-    capacity = num_blocks * n + 1
+    if compat_bugs:
+        from ..parallel.reduce import compat_capacity
+
+        capacity = compat_capacity(num_blocks, n, num_ranks)
+    else:
+        capacity = num_blocks * n + 1
 
     t0 = time.perf_counter()
     costs, ids, length, cost = _emulated_step(
-        block_d, offsets, jnp.asarray(valid), dist, num_ranks, capacity, dtype
+        block_d, offsets, jnp.asarray(valid), dist, num_ranks, capacity, dtype,
+        compat_bugs
     )
     cost.block_until_ready()
     plan = build_plan(n)

@@ -97,7 +97,21 @@ def merge_tours(t1: PaddedTour, t2: PaddedTour, dist: jnp.ndarray) -> PaddedTour
 
     # --- splice (tsp.cpp:229-259) ---
     l2p = len2 - 1  # tour 2 with its closing duplicate popped
-    p2_rot = jnp.where(j_star >= l2p, 0, j_star)  # index of right-edge head
+    # the reference rotates until the HEAD VALUE matches the chosen
+    # right-edge head cities2[j_star] (tsp.cpp:236-239), i.e. it stops at
+    # the FIRST occurrence of that id in the POPPED vector — identical to
+    # the positional index on duplicate-free closed tours (where
+    # ids2[len2-1] == ids2[0]), but not when ids repeat (possible only
+    # under --compat-bugs corrupted operands, SURVEY.md quirk #5)
+    vj = ids2[j_star]
+    match2 = (ids2 == vj) & (i2 < l2p)
+    first = jnp.argmax(match2).astype(jnp.int32)
+    # value absent from the popped vector => the real reference spins
+    # forever (quirk #6 mechanism); fall back to the positional index —
+    # we cannot (and should not) emulate a hang
+    p2_rot = jnp.where(
+        match2.any(), first, jnp.where(j_star >= l2p, 0, j_star)
+    )
     a_id = ids1[i_star]
     b_id = ids1[jnp.where(i_star + 1 >= len1, 0, i_star + 1)]
 

@@ -18,12 +18,18 @@ targeted by a round receive zeros; a zero-length operand means "no data" and
 the combine keeps the local solution, which also covers idle ranks
 (``procNum > numBlocks`` early-exits in the reference, tsp.cpp:326-330).
 
-Deviation (documented): the reference's receive path accumulates received
-cities into a never-cleared vector, so any rank that receives twice merges a
-corrupted operand (SURVEY.md quirk #5). This implementation merges the
-actual operands; single-rank parity (the oracle-verifiable case) is
-unaffected. A byte-parity bug-emulation mode could be added if multi-rank
-MPI goldens ever become capturable (no MPI toolchain exists here).
+Deviation (documented + emulatable): the reference's receive path
+accumulates received cities into a never-cleared vector, so any rank that
+receives twice merges a corrupted operand (SURVEY.md quirk #5). The
+default implementation merges the actual operands; single-rank parity
+(the oracle-verifiable case) is unaffected. ``compat_bugs=True`` on the
+rank-emulated reduce (the ``--compat-bugs`` CLI flag) replicates the
+corruption faithfully — per-rank accumulation buffers grow across rounds
+exactly like the reference's ``path`` vector, so a p-rank result matches
+what a real p-rank MPI run of the unmodified reference would print (no
+MPI toolchain exists here to capture goldens; the emulation is validated
+against a literal host-side simulation of the reference semantics in
+tests/test_distributed.py).
 
 The scalar-incumbent analog (``lax.pmin`` over the mesh) used by the B&B
 engine lives here too.
@@ -182,6 +188,29 @@ def pmin_incumbent(value: jnp.ndarray, axis_name: str = RANK_AXIS) -> jnp.ndarra
     return jax.lax.pmin(value, axis_name)
 
 
+def compat_capacity(num_blocks: int, n: int, num_ranks: int) -> int:
+    """Buffer size needed by the ``compat_bugs`` reduce (host simulation).
+
+    Under quirk #5 the operand a receiver merges is its ACCUMULATED receive
+    buffer, so solution lengths inflate beyond ``num_blocks*n + 1``; this
+    walks the tree schedule with pure integer arithmetic to bound them.
+    """
+    counts = rank_block_counts(num_blocks, num_ranks)
+    sol = [c * n + 1 if c else 0 for c in counts]
+    acc = [0] * num_ranks
+    peak = max(sol)
+    for _name, pairs in tree_schedule(num_ranks):
+        for s, dd in pairs:
+            acc[dd] += sol[s]
+            rb = acc[dd]
+            if rb and sol[dd]:
+                sol[dd] = sol[dd] + rb - 1
+            elif rb:
+                sol[dd] = rb
+            peak = max(peak, sol[dd], acc[dd])
+    return peak
+
+
 def tree_reduce_single_device(
     tours: jnp.ndarray,
     costs: jnp.ndarray,
@@ -189,6 +218,7 @@ def tree_reduce_single_device(
     dist: jnp.ndarray,
     capacity: int,
     num_ranks: int,
+    compat_bugs: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Rank-emulated reduction on ONE device: same tree, vmapped rounds.
 
@@ -197,6 +227,11 @@ def tree_reduce_single_device(
     analog of the p=1 MPI-stub trick (SURVEY.md §4). Virtual-rank folds run
     as one vmap over the rank dimension; each tree round is one vmapped
     pairwise merge over that round's (receiver, sender) pairs.
+
+    ``compat_bugs``: replicate SURVEY.md quirk #5 — each receiver merges
+    its ACCUMULATED (never-cleared) receive buffer instead of the actual
+    operand, with the latest received cost; ``capacity`` must come from
+    ``compat_capacity`` (lengths inflate).
     """
     pk, l = tours.shape
     if pk % num_ranks:
@@ -211,12 +246,31 @@ def tree_reduce_single_device(
     )  # PaddedTour of stacked [P, ...] leaves
 
     combine_v = jax.vmap(_combine, in_axes=(0, 0, None))
+    if compat_bugs:
+        acc_ids = jnp.zeros((num_ranks, capacity), jnp.int32)
+        acc_len = jnp.zeros(num_ranks, jnp.int32)
     for _name, pairs in tree_schedule(num_ranks):
         src = jnp.asarray([s for s, _ in pairs])
         dst = jnp.asarray([d for _, d in pairs])
         mine = jax.tree.map(lambda x: x[dst], folds)
-        recv = jax.tree.map(lambda x: x[src], folds)
-        merged = combine_v(PaddedTour(*mine), PaddedTour(*recv), dist)
+        recv = PaddedTour(*jax.tree.map(lambda x: x[src], folds))
+        if compat_bugs:
+            # append the sender's cities onto the receiver's never-cleared
+            # buffer (tsp.cpp:67,93-95,114-117) and merge THAT, with the
+            # latest received cost
+            lanes = jnp.arange(capacity)
+
+            def append(buf, alen, ids, ln):
+                dest = jnp.where(lanes < ln, alen + lanes, capacity)
+                return buf.at[dest].set(ids, mode="drop"), alen + ln
+
+            new_acc, new_len = jax.vmap(append)(
+                acc_ids[dst], acc_len[dst], recv.ids, recv.length
+            )
+            acc_ids = acc_ids.at[dst].set(new_acc)
+            acc_len = acc_len.at[dst].set(new_len)
+            recv = PaddedTour(new_acc, new_len, recv.cost)
+        merged = combine_v(PaddedTour(*mine), recv, dist)
         folds = PaddedTour(
             *jax.tree.map(lambda x, m: x.at[dst].set(m), tuple(folds), tuple(merged))
         )

@@ -68,6 +68,14 @@ def build_parser() -> argparse.ArgumentParser:
         "re-measured cost (a deliberate deviation from the reference's "
         "formulaic merge cost, SURVEY.md quirk #4)",
     )
+    p.add_argument(
+        "--compat-bugs",
+        action="store_true",
+        help="byte-parity bug emulation for --ranks > 1: replicate the "
+        "reference's reduce-side path-accumulation corruption (SURVEY.md "
+        "quirk #5) so the printed cost matches a real p-rank MPI run of "
+        "the unmodified reference",
+    )
     return p
 
 
@@ -200,7 +208,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             if args.ranks > 1:
                 res = run_pipeline_ranks(
                     n, nb, args.gridDimX, args.gridDimY, args.ranks,
-                    seed=args.seed, dtype=dtype,
+                    seed=args.seed, dtype=dtype, compat_bugs=args.compat_bugs,
                 )
             else:
                 res = run_pipeline(
