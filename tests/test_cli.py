@@ -53,6 +53,21 @@ def test_ranks_flag_changes_merge_order(capsys):
     assert cost1 != cost2  # non-associative operator, different tree
 
 
+def test_compat_bugs_flag_changes_multirank_cost(capsys):
+    """--compat-bugs (quirk #5 emulation) must alter the multi-rank result
+    (any rank receiving twice merges a corrupted operand) while leaving
+    p<=2 trees — where no rank receives twice past the downshift — intact
+    relative to its own deterministic output."""
+    args = ["5", "8", "300", "300", "--backend=cpu", "--ranks=4"]
+    code1, out1, _ = run_cli(capsys, args)
+    code2, out2, _ = run_cli(capsys, args + ["--compat-bugs"])
+    assert code1 == code2 == 0
+    assert out1.strip().split()[-1] != out2.strip().split()[-1]
+    # deterministic: same flag, same output
+    code3, out3, _ = run_cli(capsys, args + ["--compat-bugs"])
+    assert out3.strip().split()[-1] == out2.strip().split()[-1]
+
+
 def test_metrics_flag_emits_json(capsys):
     import json
 

@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# One-shot TPU benchmark artifact capture (run when the TPU tunnel is up).
+#
+# Produces:
+#   BENCH_TPU_PIPELINE.json      - pipeline, tree fold (bench.py default)
+#   BENCH_TPU_PIPELINE_SCAN.json - pipeline, r01/r02 sequential fold
+#   BENCH_BNB_TPU.json           - north-star B&B nodes/sec (eil51, proven)
+#   traces/tpu_pipeline/         - jax.profiler trace of the pipeline CLI
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== pipeline (tree fold) =="
+python bench.py 2> >(tail -5 >&2) | tee BENCH_TPU_PIPELINE.json
+
+echo "== pipeline (scan fold, r01/r02 method) =="
+TSP_BENCH_FOLD=scan python bench.py 2> >(tail -3 >&2) | tee BENCH_TPU_PIPELINE_SCAN.json
+
+echo "== B&B eil51 (north-star metric) =="
+TSP_BENCH=bnb python bench.py 2> >(tail -3 >&2) | tee BENCH_BNB_TPU.json
+
+echo "== profiler trace =="
+python -m tsp_mpi_reduction_tpu 16 100 1000 1000 --backend=tpu \
+    --dtype=float32 --trace traces/tpu_pipeline | tail -1
+echo "trace written to traces/tpu_pipeline"

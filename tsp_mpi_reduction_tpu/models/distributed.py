@@ -32,6 +32,7 @@ from ..ops.generator import generate_instance
 from ..ops.held_karp import build_plan, require_x64_if_float64, solve_blocks_from_dists
 from ..parallel.mesh import RANK_AXIS, make_rank_mesh
 from ..parallel.reduce import (
+    compat_capacity,
     rank_block_counts,
     reduce_tours_on_mesh,
     tree_reduce_single_device,
@@ -187,8 +188,6 @@ def run_pipeline_ranks(
     block_d = jnp.asarray(block_distance_slices(dist, num_blocks, n))[safe]
     offsets = jnp.asarray(safe * n, jnp.int32)
     if compat_bugs:
-        from ..parallel.reduce import compat_capacity
-
         capacity = compat_capacity(num_blocks, n, num_ranks)
     else:
         capacity = num_blocks * n + 1
