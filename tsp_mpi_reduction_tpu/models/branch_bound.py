@@ -1001,8 +1001,9 @@ def solve_sharded(
         leaves["count"].append(np.int32(len(mine)))
         leaves["overflow"].append(False)
     spec = NamedSharding(mesh, P(RANK_AXIS))
+    resumed_reservoir = None
     if resume_from:
-        fr_h, ic_h, itour_h, _ = restore(
+        fr_h, ic_h, itour_h, resumed_reservoir = restore(
             resume_from, expect_d=d, expect_bound=bound, expect_ranks=num_ranks
         )
         fr = Frontier(
@@ -1011,6 +1012,9 @@ def solve_sharded(
         ic = jax.device_put(np.asarray(ic_h), spec)
         itour = jax.device_put(np.asarray(itour_h), spec)
         inc_cost0 = float(np.asarray(ic_h)[0])
+        # the restored arrays define the true per-rank capacity — the
+        # caller's argument must not disarm the spill trigger below
+        capacity_per_rank = int(np.asarray(fr_h.path).shape[1])
     else:
         inc_tour_np = strong_incumbent(d, starts=16, perturbations=ils_rounds)
         inc_cost0 = tour_cost(d_np, inc_tour_np)
@@ -1060,14 +1064,12 @@ def solve_sharded(
         all_c = jax.lax.all_gather(c2, RANK_AXIS)
         all_t = jax.lax.all_gather(t2, RANK_AXIS)
         b = jnp.argmin(all_c)
-        total = jax.lax.psum(f2.count, RANK_AXIS)
         total_nodes = jax.lax.psum(nodes, RANK_AXIS)
         rank_nodes = jax.lax.all_gather(nodes, RANK_AXIS)
         return (
             jax.tree.map(lambda x: x[None], tuple(f2)),
             all_c[b][None],
             all_t[b][None],
-            total[None],
             total_nodes[None],
             rank_nodes[None],
         )
@@ -1095,10 +1097,56 @@ def solve_sharded(
                 P(RANK_AXIS),
                 P(RANK_AXIS),
                 P(RANK_AXIS),
-                P(RANK_AXIS),
             ),
         )
     )
+
+    # per-rank host reservoirs: the sharded analog of solve()'s overflow
+    # spill — a rank whose stack nears capacity sheds its worst-bound
+    # bottom half to the host; when the whole mesh drains, spilled nodes
+    # flow back (incumbent-filtered), so capacity pressure never converts
+    # into the terminal exactness-lost flag
+    reservoirs = [_Reservoir() for _ in range(num_ranks)]
+    if resumed_reservoir is not None and len(resumed_reservoir):
+        # a resumed checkpoint's spilled nodes land on rank 0; the ring
+        # balance spreads them once they flow back onto the device
+        reservoirs[0] = resumed_reservoir
+    headroom = min(
+        capacity_per_rank // 2, max(1, inner_steps) * k * (n - 1)
+    )
+
+    def spill_refill(fr, inc_best):
+        counts = np.asarray(fr.count)
+        spilling = counts > capacity_per_rank - headroom
+        refilling = (counts == 0) & np.asarray(
+            [len(rv) > 0 for rv in reservoirs]
+        )
+        if not (spilling.any() or refilling.any()):
+            return fr, counts.sum()
+        # ONE gather of the stacked frontier; untouched ranks pass through
+        host = {f: np.asarray(getattr(fr, f)) for f in Frontier._fields}
+        locals_ = [
+            Frontier(*(host[f][r] for f in Frontier._fields))
+            for r in range(num_ranks)
+        ]
+        for r in range(num_ranks):
+            if not (spilling[r] or refilling[r]):
+                continue
+            lr = Frontier(*(jnp.asarray(x) for x in locals_[r]))
+            if spilling[r]:
+                lr = reservoirs[r].spill(lr, keep=capacity_per_rank // 2)
+            else:
+                lr = reservoirs[r].refill(lr, inc_best, integral)
+            locals_[r] = Frontier(*(np.asarray(x) for x in lr))
+        stacked = Frontier(
+            *(
+                jax.device_put(
+                    np.stack([getattr(lr, f) for lr in locals_]), spec
+                )
+                for f in Frontier._fields
+            )
+        )
+        return stacked, int(sum(int(lr.count) for lr in locals_))
 
     t0 = time.perf_counter()
     setup_s = t0 - t_setup
@@ -1107,35 +1155,41 @@ def solve_sharded(
     nodes = 0
     it = 0
     rank_nodes = np.zeros(num_ranks, np.int64)
+    total0 = 1
     while it < max_iters:
         out = step(tuple(fr), ic, itour, d32, min_out, bound_adj, bd.dbar,
                    bd.pi, bd.slack, bd.ascent_step, bd.lam_budget)
         fr = Frontier(*out[0])
-        ic, itour, total, step_nodes = out[1], out[2], out[3], out[4]
-        rank_nodes = rank_nodes + np.asarray(out[5][0])
+        ic, itour, step_nodes = out[1], out[2], out[3]
+        rank_nodes = rank_nodes + np.asarray(out[4][0])
         nodes += int(step_nodes[0])
         it += inner_steps
         best = float(ic[0])
         if best < last_inc:
             last_inc = best
             t_best = time.perf_counter() - t0
+        fr, total0 = spill_refill(fr, best)
         if (
             checkpoint_every
             and checkpoint_path
             and it % max(checkpoint_every, inner_steps) < inner_steps
         ):
             save(checkpoint_path, fr, ic, itour, d=d, bound=bound,
-                 num_ranks=num_ranks)
-        if int(total[0]) == 0:
+                 num_ranks=num_ranks, reservoir=_merge_reservoirs(reservoirs))
+        if int(total0) == 0:
             break
         if time_limit_s is not None and time.perf_counter() - t0 > time_limit_s:
             break
     wall = time.perf_counter() - t0
     overflow = bool(np.asarray(fr.overflow).any())
-    proven = int(total[0]) == 0 and not overflow
+    proven = (
+        int(total0) == 0
+        and all(len(rv) == 0 for rv in reservoirs)
+        and not overflow
+    )
     if checkpoint_path and not proven:
         save(checkpoint_path, fr, ic, itour, d=d, bound=bound,
-             num_ranks=num_ranks)
+             num_ranks=num_ranks, reservoir=_merge_reservoirs(reservoirs))
     return BnBResult(
         cost=float(ic[0]),
         tour=np.asarray(itour)[0],
@@ -1149,6 +1203,14 @@ def solve_sharded(
         nodes_per_rank=rank_nodes,
         setup_seconds=setup_s,
     )
+
+
+def _merge_reservoirs(reservoirs) -> Optional["_Reservoir"]:
+    """Concatenate per-rank reservoirs into one (for checkpointing)."""
+    merged = _Reservoir()
+    for rv in reservoirs:
+        merged.chunks.extend(rv.chunks)
+    return merged if len(merged) else None
 
 
 def _norm_ckpt_path(path: str) -> str:
