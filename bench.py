@@ -144,10 +144,14 @@ def main() -> int:
 
         select_backend("cpu")
 
-    if os.environ.get("TSP_BENCH", "pipeline") == "bnb":
-        return bench_bnb()
+    from tsp_mpi_reduction_tpu.utils.backend import enable_persistent_cache
 
     import jax
+
+    enable_persistent_cache(jax.default_backend())
+
+    if os.environ.get("TSP_BENCH", "pipeline") == "bnb":
+        return bench_bnb()
     import jax.numpy as jnp
 
     from tsp_mpi_reduction_tpu.ops import held_karp

@@ -45,6 +45,9 @@ def main() -> int:
     args = ap.parse_args()
 
     platform = select_backend(args.backend)
+    from tsp_mpi_reduction_tpu.utils.backend import enable_persistent_cache
+
+    enable_persistent_cache(platform)
     if platform == "cpu" and args.ranks > 1:
         # CPU can host an arbitrary virtual mesh — provision one device per
         # requested rank (the conftest trick, SURVEY.md §4). Keyed on the

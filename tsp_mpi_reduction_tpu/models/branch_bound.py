@@ -736,6 +736,30 @@ class _Reservoir:
         """Reload up to half the capacity from the reservoir onto an empty
         device stack, dropping nodes the incumbent has since closed."""
         capacity = fr.path.shape[0]
+        host = {f: np.asarray(getattr(fr, f)).copy() for f in self._ARRAYS}
+        take = self.refill_host(host, capacity, inc_cost, integral)
+        if take == 0:
+            return fr
+        return Frontier(
+            count=jnp.asarray(take, jnp.int32),
+            overflow=fr.overflow,
+            **{f: jnp.asarray(host[f]) for f in self._ARRAYS},
+        )
+
+    def spill_host(self, host: dict, count: int, keep: int) -> int:
+        """In-place numpy variant of ``spill`` (sharded path: the frontier
+        is already a host copy). Returns the new count."""
+        cut = max(count - keep, 0)
+        if cut == 0:
+            return count
+        self.chunks.append({f: host[f][:cut].copy() for f in self._ARRAYS})
+        for f in self._ARRAYS:
+            host[f][: count - cut] = host[f][cut:count]
+        return count - cut
+
+    def refill_host(self, host: dict, capacity: int, inc_cost, integral) -> int:
+        """In-place numpy variant of ``refill``; host arrays must be empty
+        (count 0). Returns the new count."""
         merged = {
             f: np.concatenate([c[f] for c in self.chunks]) for f in self._ARRAYS
         }
@@ -756,19 +780,12 @@ class _Reservoir:
             self.chunks.append({f: merged[f][order[take:]] for f in self._ARRAYS})
             merged = {f: merged[f][sel] for f in self._ARRAYS}
         if take == 0:
-            return fr
+            return 0
         # stack order: worst bound at the bottom, best on top (pop side)
         order = np.argsort(-merged["bound"], kind="stable")
-        arrays = {}
         for f in self._ARRAYS:
-            buf = np.asarray(getattr(fr, f)).copy()
-            buf[:take] = merged[f][order]
-            arrays[f] = jnp.asarray(buf)
-        return Frontier(
-            count=jnp.asarray(take, jnp.int32),
-            overflow=fr.overflow,
-            **arrays,
-        )
+            host[f][:take] = merged[f][order]
+        return take
 
 
 def make_root_frontier(n: int, capacity: int, min_out: np.ndarray, dtype=jnp.float32) -> Frontier:
@@ -844,10 +861,7 @@ def solve(
         inc_tour = jnp.asarray(inc_tour_np, jnp.int32)
         fr = make_root_frontier(n, capacity, min_out_np)
 
-    # spill before a single inner batch could possibly overflow the stack
-    # (each of the ``inner`` steps pushes at most k*(n-1) children); for
-    # small capacities fall back to keeping the top half
-    headroom = min(capacity // 2, max(1, inner_steps) * k * (n - 1))
+    headroom = _spill_headroom(capacity, inner_steps, k, n)
     t0 = time.perf_counter()
     setup_s = t0 - t_setup
     t_best = 0.0
@@ -1111,9 +1125,7 @@ def solve_sharded(
         # a resumed checkpoint's spilled nodes land on rank 0; the ring
         # balance spreads them once they flow back onto the device
         reservoirs[0] = resumed_reservoir
-    headroom = min(
-        capacity_per_rank // 2, max(1, inner_steps) * k * (n - 1)
-    )
+    headroom = _spill_headroom(capacity_per_rank, inner_steps, k, n)
 
     def spill_refill(fr, inc_best):
         counts = np.asarray(fr.count)
@@ -1123,30 +1135,28 @@ def solve_sharded(
         )
         if not (spilling.any() or refilling.any()):
             return fr, counts.sum()
-        # ONE gather of the stacked frontier; untouched ranks pass through
-        host = {f: np.asarray(getattr(fr, f)) for f in Frontier._fields}
-        locals_ = [
-            Frontier(*(host[f][r] for f in Frontier._fields))
-            for r in range(num_ranks)
-        ]
+        # ONE gather of the stacked frontier; spill/refill mutate the host
+        # copies in place, then ONE re-upload of the stacked arrays
+        host = {
+            f: np.asarray(getattr(fr, f)).copy() for f in _Reservoir._ARRAYS
+        }
+        new_counts = counts.copy()
         for r in range(num_ranks):
-            if not (spilling[r] or refilling[r]):
-                continue
-            lr = Frontier(*(jnp.asarray(x) for x in locals_[r]))
+            view = {f: host[f][r] for f in _Reservoir._ARRAYS}
             if spilling[r]:
-                lr = reservoirs[r].spill(lr, keep=capacity_per_rank // 2)
-            else:
-                lr = reservoirs[r].refill(lr, inc_best, integral)
-            locals_[r] = Frontier(*(np.asarray(x) for x in lr))
-        stacked = Frontier(
-            *(
-                jax.device_put(
-                    np.stack([getattr(lr, f) for lr in locals_]), spec
+                new_counts[r] = reservoirs[r].spill_host(
+                    view, int(counts[r]), keep=capacity_per_rank // 2
                 )
-                for f in Frontier._fields
-            )
+            elif refilling[r]:
+                new_counts[r] = reservoirs[r].refill_host(
+                    view, capacity_per_rank, inc_best, integral
+                )
+        stacked = Frontier(
+            count=jax.device_put(new_counts.astype(np.int32), spec),
+            overflow=fr.overflow,
+            **{f: jax.device_put(host[f], spec) for f in _Reservoir._ARRAYS},
         )
-        return stacked, int(sum(int(lr.count) for lr in locals_))
+        return stacked, int(new_counts.sum())
 
     t0 = time.perf_counter()
     setup_s = t0 - t_setup
@@ -1203,6 +1213,13 @@ def solve_sharded(
         nodes_per_rank=rank_nodes,
         setup_seconds=setup_s,
     )
+
+
+def _spill_headroom(capacity: int, inner_steps: int, k: int, n: int) -> int:
+    """Spill before a single inner batch could possibly overflow the stack
+    (each of the ``inner_steps`` steps pushes at most k*(n-1) children);
+    for small capacities fall back to keeping the top half."""
+    return min(capacity // 2, max(1, inner_steps) * k * (n - 1))
 
 
 def _merge_reservoirs(reservoirs) -> Optional["_Reservoir"]:

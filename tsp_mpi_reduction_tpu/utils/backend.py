@@ -114,3 +114,25 @@ def select_backend(name: str = "auto") -> str:
             f"no accelerator platform initialized (tried {candidates}): {last_err}"
         )
     raise ValueError(f"unknown backend {name!r} (expected cpu|tpu|auto)")
+
+
+def enable_persistent_cache(platform: str) -> None:
+    """Point jax at the shared on-disk compilation cache.
+
+    Repeat invocations (CLI runs, bench.py, bnb_solve) then skip the slow
+    TPU compiles. Not used on CPU: XLA:CPU AOT reload warns about machine
+    feature mismatches there, and CPU compiles are sub-second anyway.
+    """
+    if platform == "cpu":
+        return
+    import os
+
+    import jax
+
+    cache_dir = os.path.join(
+        os.path.expanduser("~"), ".cache", "tsp_mpi_reduction_tpu", "jax_cache"
+    )
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)

@@ -154,6 +154,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                 file=sys.stderr,
             )
             return 2
+        if args.compat_bugs:
+            print(
+                "error: --compat-bugs is implemented by the jax rank "
+                "emulation (use --backend=cpu/tpu)",
+                file=sys.stderr,
+            )
+            return 2
         from .. import native
 
         print(reporting.banner_line(n, nb))
@@ -179,19 +186,9 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if dtype == "float64":
         jax.config.update("jax_enable_x64", True)
-    if platform != "cpu":
-        # persistent compilation cache: repeat invocations skip the slow TPU
-        # compiles. (Not used on CPU: XLA:CPU AOT reload warns about machine
-        # feature mismatches there, and CPU compiles are sub-second anyway.)
-        import os
+    from .backend import enable_persistent_cache
 
-        cache_dir = os.path.join(
-            os.path.expanduser("~"), ".cache", "tsp_mpi_reduction_tpu", "jax_cache"
-        )
-        os.makedirs(cache_dir, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+    enable_persistent_cache(platform)
 
     from ..models.distributed import run_pipeline_ranks
     from ..models.pipeline import run_pipeline
