@@ -2,18 +2,15 @@
 # One-shot TPU benchmark artifact capture (run when the TPU tunnel is up).
 #
 # Produces:
-#   BENCH_TPU_PIPELINE.json      - pipeline, tree fold (bench.py default)
-#   BENCH_TPU_PIPELINE_SCAN.json - pipeline, r01/r02 sequential fold
-#   BENCH_BNB_TPU.json           - north-star B&B nodes/sec (eil51, proven)
-#   traces/tpu_pipeline/         - jax.profiler trace of the pipeline CLI
+#   BENCH_TPU_PIPELINE.json - pipeline; bench.py measures BOTH fold shapes
+#                             and reports the faster (see its "fold" key)
+#   BENCH_BNB_TPU.json      - north-star B&B nodes/sec (eil51, proven)
+#   traces/tpu_pipeline/    - jax.profiler trace of the pipeline CLI
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== pipeline (tree fold) =="
-python bench.py 2> >(tail -5 >&2) | tee BENCH_TPU_PIPELINE.json
-
-echo "== pipeline (scan fold, r01/r02 method) =="
-TSP_BENCH_FOLD=scan python bench.py 2> >(tail -3 >&2) | tee BENCH_TPU_PIPELINE_SCAN.json
+echo "== pipeline (both folds; faster one reported) =="
+python bench.py 2> >(tail -8 >&2) | tee BENCH_TPU_PIPELINE.json
 
 echo "== B&B eil51 (north-star metric) =="
 TSP_BENCH=bnb python bench.py 2> >(tail -3 >&2) | tee BENCH_BNB_TPU.json
