@@ -104,12 +104,17 @@ def bench_bnb() -> int:
     inst = tsplib.embedded(name)
     d = inst.distance_matrix()
     k = int(os.environ.get("TSP_BENCH_K", "256"))
+    # per-node mini-ascent depth: more steps = fewer nodes but more Prims
+    # per pop; the best time-to-proof point is hardware-dependent
+    na = int(os.environ.get("TSP_BENCH_NODE_ASCENT", "2"))
 
     t0 = time.perf_counter()
-    bb.solve(d, capacity=1 << 17, k=k, inner_steps=8, max_iters=8)
+    bb.solve(d, capacity=1 << 17, k=k, inner_steps=8, max_iters=8, node_ascent=na)
     print(f"warmup (compile): {time.perf_counter() - t0:.1f}s", file=sys.stderr)
 
-    res = bb.solve(d, capacity=1 << 17, k=k, inner_steps=8, time_limit_s=600)
+    res = bb.solve(
+        d, capacity=1 << 17, k=k, inner_steps=8, time_limit_s=600, node_ascent=na
+    )
     ok = res.proven_optimal and res.cost == inst.known_optimum
     print(
         f"{name}: cost={res.cost} (known {inst.known_optimum}) "
