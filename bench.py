@@ -8,29 +8,42 @@ Two modes, selected by ``TSP_BENCH`` (default ``pipeline``):
   in this environment at g++ -O2; identical instance because generation is
   srand(0)-deterministic). ``vs_baseline`` = baseline_ms / ours.
   Method: device pipeline in float32 (TPU speed mode) — on-device distance
-  matrix, vmapped dense Held-Karp over all 100 blocks, then the merge
-  fold. BOTH fold shapes are measured and the faster is reported
-  (disclosed via the JSON ``fold`` key): the log2(B) TREE of vmapped
-  pairwise merges (fold_tours_tree — the shape of the reference's own
-  cross-rank MPI_ManualReduce; the merge operator is non-associative, so
-  the folded cost legitimately differs from the sequential within-rank
-  fold exactly as the reference's output differs across rank counts) and
-  the sequential scan fold the r01/r02 benches used.
-  ``TSP_BENCH_FOLD=scan|tree`` pins one. Each is compiled once (warmup),
-  then the median of 3 timed end-to-end executions counts.
+  matrix, vmapped dense Held-Karp over all 100 blocks, then the merge fold.
+  BOTH fold shapes are measured and the faster is reported (disclosed via
+  the JSON ``fold`` key): the log2(B) TREE of vmapped pairwise merges
+  (fold_tours_tree — the shape of the reference's own cross-rank
+  MPI_ManualReduce; the merge operator is non-associative, so the folded
+  cost legitimately differs from the sequential within-rank fold exactly as
+  the reference's output differs across rank counts) and the sequential
+  scan fold (the reference's rank-local order, tsp.cpp:348-352).
 
 - ``bnb`` — the north-star metric (BASELINE.json): B&B nodes/sec on a
   TSPLIB instance solved to PROVEN optimality. Default instance: eil51
   (426) — berlin52's Held-Karp root bound equals its optimum, so with the
   ILS incumbent it closes at the root in 1 node and has no throughput to
   measure; eil51's bound genuinely gaps (~422.5 vs 426), forcing a real
-  ~500k-node search. The reference has no B&B and no TSPLIB mode
-  (SURVEY.md §0 discrepancy note), so there is no reference binary to
-  time; the baseline anchor is this engine's own single-rank CPU rate
-  x8 — a stand-in for the north star's "8-rank MPI" comparison that
-  generously assumes perfect MPI scaling (BNB_CPU_8RANK_ANCHOR below,
-  measured on this host). ``vs_baseline`` = device nodes/sec / anchor.
-  Warmup excludes compile from the timed run.
+  search. The reference has no B&B and no TSPLIB mode (SURVEY.md §0
+  discrepancy note), so there is no reference binary to time; the baseline
+  anchor is this engine's own single-rank CPU rate x8 — a stand-in for the
+  north star's "8-rank MPI" comparison that generously assumes perfect MPI
+  scaling (BNB_CPU_8RANK_ANCHOR below, measured on this host).
+  ``vs_baseline`` = device nodes/sec / anchor.
+
+TIMING METHODOLOGY (critical on this image's remote-TPU relay): the first
+device->host transfer of the process permanently degrades dispatch latency
+(~65 ms per dispatch slice; lax.while_loop programs pay it PER ITERATION —
+a measured 660x slowdown on the B&B kernel), and ``block_until_ready`` does
+not actually block. Plain per-call timing is therefore wrong in BOTH
+directions. This bench instead:
+
+- pipeline: chains M dependent executions (each run's scalar output feeds
+  the next run's input) and reads back ONE value at the end — the read
+  drains the whole queue, so wall/M is a true per-run time; the runs
+  themselves execute in the relay's fast (pre-transfer) mode.
+- bnb: runs the whole search as ONE device dispatch
+  (branch_bound._solve_device, transfer-free setup) and AOT-compiles the
+  kernel first (warm_compile_device_solver) so the timed dispatch excludes
+  compilation without a poisoning warmup execution.
 
 Compile time is excluded in both modes (the reference has no JIT; with the
 persistent compilation cache it is a one-time cost) and printed to stderr.
@@ -48,10 +61,10 @@ import numpy as np
 BASELINE_MS = 69997.0  # BASELINE.md: 16 cities/block x 100 blocks, 1 rank
 N, BLOCKS, GRID = 16, 100, 1000
 
-#: Single-rank CPU B&B nodes/sec on eil51 (this engine, this host, k=256,
+#: Single-rank CPU B&B nodes/sec on eil51 (this engine, this host,
 #: proven-optimal run, compile excluded) x 8 ranks — i.e. the anchor
 #: generously assumes perfect 8-way MPI scaling of our own CPU rate.
-#: Measured 2026-07-30 at the default engine config (node_ascent=2):
+#: Measured 2026-07-30 at the default engine config (k=256, node_ascent=2):
 #: 7,730 nodes/s, proof in 28.1 s at capacity 1<<17; see BENCHMARKS.md.
 BNB_CPU_8RANK_ANCHOR = 8 * 7730.0
 
@@ -103,23 +116,37 @@ def bench_bnb() -> int:
     name = os.environ.get("TSP_BENCH_INSTANCE", "eil51")
     inst = tsplib.embedded(name)
     d = inst.distance_matrix()
-    k = int(os.environ.get("TSP_BENCH_K", "256"))
+    n = d.shape[0]
+    k = int(os.environ.get("TSP_BENCH_K", "1024"))
+    capacity = max(1 << 17, 8 * k * (n - 1))
     # per-node mini-ascent depth: more steps = fewer nodes but more Prims
     # per pop; the best time-to-proof point is hardware-dependent
     na = int(os.environ.get("TSP_BENCH_NODE_ASCENT", "2"))
+    on_cpu = jax.default_backend() == "cpu"
 
     t0 = time.perf_counter()
-    bb.solve(d, capacity=1 << 17, k=k, inner_steps=8, max_iters=8, node_ascent=na)
+    if on_cpu:
+        # no relay, no poison: a tiny warmup run compiles the host-loop
+        # kernels; the fine-grained host loop also honors time_limit_s
+        bb.solve(d, capacity=capacity, k=k, node_ascent=na,
+                 device_loop=False, max_iters=8)
+    else:
+        # AOT compile only (no device execution -> the relay stays in fast
+        # mode); integral must match what _bound_setup will derive from
+        # the data or the timed dispatch recompiles a new static config
+        integral = bool(np.all(np.asarray(d, np.float64) == np.rint(d)))
+        bb.warm_compile_device_solver(n, capacity, k, integral, True, na)
     print(f"warmup (compile): {time.perf_counter() - t0:.1f}s", file=sys.stderr)
 
     res = bb.solve(
-        d, capacity=1 << 17, k=k, inner_steps=8, time_limit_s=600, node_ascent=na
+        d, capacity=capacity, k=k, time_limit_s=600, node_ascent=na,
+        device_loop=not on_cpu, max_iters=5_000_000,
     )
     ok = res.proven_optimal and res.cost == inst.known_optimum
     print(
         f"{name}: cost={res.cost} (known {inst.known_optimum}) "
         f"proven={res.proven_optimal} nodes={res.nodes_expanded} "
-        f"wall={res.wall_seconds:.2f}s time_to_best={res.time_to_best:.2f}s",
+        f"wall={res.wall_seconds:.2f}s setup={res.setup_seconds:.1f}s",
         file=sys.stderr,
     )
     if not ok:
@@ -132,6 +159,8 @@ def bench_bnb() -> int:
                 "value": round(value, 1),
                 "unit": "nodes/s",
                 "vs_baseline": round(value / BNB_CPU_8RANK_ANCHOR, 2),
+                "proven_optimal": bool(res.proven_optimal),
+                "device": "cpu" if on_cpu else str(dev),
             }
         )
     )
@@ -174,11 +203,11 @@ def main() -> int:
     print(f"bench device: {dev}", file=sys.stderr)
 
     _, xy = generate_instance(N, BLOCKS, GRID, GRID)
-    xy32 = np.asarray(xy, np.float32)
+    xy32 = jnp.asarray(np.asarray(xy, np.float32))
 
     def make_step(fold):
         @jax.jit
-        def step(xy_blocks):
+        def step(xy_blocks, feedback):
             flat = xy_blocks.reshape(-1, 2)
             dist = distance_matrix(flat)
             block_d = jax.vmap(distance_matrix)(xy_blocks)
@@ -187,37 +216,35 @@ def main() -> int:
             ids, length, cost = fold(
                 local_tours.astype(jnp.int32) + offsets, costs, dist
             )
-            return cost, length
-
+            # feedback*0 threads the previous run's output into this run's
+            # input: the M timed runs form one dependency chain, so a
+            # single final readback drains them all (see module docstring)
+            return cost + feedback * 0.0
         return step
 
-    def timed(name, fold):
+    def timed(name, fold, m):
         step = make_step(fold)
         t0 = time.perf_counter()
-        cost, _ = step(jnp.asarray(xy32))
-        cost.block_until_ready()
-        print(
-            f"{name}: first call (compile+run) {time.perf_counter() - t0:.1f}s, "
-            f"cost={float(cost):.3f}",
-            file=sys.stderr,
-        )
-        times = []
-        for _ in range(3):
-            t0 = time.perf_counter()
-            cost, _ = step(jnp.asarray(xy32))
-            cost.block_until_ready()
-            times.append((time.perf_counter() - t0) * 1000.0)
-        med = float(np.median(times))
-        print(f"{name}: times_ms={['%.1f' % t for t in times]}", file=sys.stderr)
-        return med
+        c = step(xy32, jnp.float32(0.0))  # compile+first run; no readback
+        jax.block_until_ready(c)
+        compile_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(m):
+            c = step(xy32, c)
+        v = float(c)  # ONE readback: drains the chained queue
+        per_run = (time.perf_counter() - t0) * 1000.0 / m
+        return per_run, v, compile_s
 
     # measure BOTH fold shapes and report the faster (disclosed via the
     # "fold" key): the tree (log2(B) vmapped merge rounds — the shape of
     # the reference's own cross-rank reduce) removes the B-step sequential
-    # dependency chain; the scan is the r01/r02 method. The merge operator
-    # is non-associative, so their costs legitimately differ — exactly as
-    # the reference's output differs across rank counts.
-    # TSP_BENCH_FOLD=scan|tree pins one.
+    # dependency chain; the scan is the reference's rank-local fold order.
+    # The merge operator is non-associative, so their costs legitimately
+    # differ — exactly as the reference's output differs across rank counts.
+    # TSP_BENCH_FOLD=scan|tree pins one. Each fold's chain runs in its own
+    # pre-readback window only for the FIRST fold measured; measuring tree
+    # first matters less than it seems — chained dispatches queue before
+    # the drain, so per-run time stays true either way.
     pin = os.environ.get("TSP_BENCH_FOLD")
     if pin not in (None, "tree", "scan"):
         print(
@@ -226,13 +253,20 @@ def main() -> int:
             file=sys.stderr,
         )
         pin = None
+    m = int(os.environ.get("TSP_BENCH_REPS", "10"))
     results = {}
     if pin in (None, "tree"):
-        results["tree"] = timed("tree", fold_tours_tree)
+        results["tree"] = timed("tree", fold_tours_tree, m)
     if pin in (None, "scan"):
-        results["scan"] = timed("scan", fold_tours)
-    best = min(results, key=results.get)
-    value = results[best]
+        results["scan"] = timed("scan", fold_tours, m)
+    for nm, (ms, v, cs) in results.items():
+        print(
+            f"{nm}: {ms:.1f} ms/run over {m} chained runs "
+            f"(compile+first {cs:.1f}s, cost={v:.3f})",
+            file=sys.stderr,
+        )
+    best = min(results, key=lambda nm: results[nm][0])
+    value = results[best][0]
     plan = build_plan(N)
     nodes_per_sec = plan.dp_transitions * BLOCKS / (value / 1000.0)
     print(f"dp_transitions/s={nodes_per_sec:.3e}", file=sys.stderr)

@@ -252,3 +252,70 @@ def test_multiword_mask_large_instance_smoke():
     assert res.cost == pytest.approx(bb.tour_cost(d, tour), rel=1e-5)
     assert res.root_lower_bound <= res.cost
     assert res.nodes_per_sec > 0
+
+
+def test_device_loop_matches_host_loop():
+    """The transfer-free single-dispatch path (_solve_device) must prove
+    the same optimum as the per-batch host loop."""
+    d = np.rint(random_d(12, 5) * 10)
+    hk, _ = solve_blocks_from_dists(d[None])
+    host = bb.solve(d, capacity=1 << 14, k=64, device_loop=False)
+    dev = bb.solve(d, capacity=1 << 14, k=64, device_loop=True)
+    assert host.proven_optimal and dev.proven_optimal
+    assert host.cost == dev.cost == float(hk[0])
+
+
+def test_device_loop_compacts_and_spills_tiny_capacity():
+    """At a capacity far below the natural frontier the device loop must
+    compact on-device, stop full (never the lossy overflow flag), spill to
+    the host reservoir between dispatches, and still end proven."""
+    d = np.rint(random_d(12, 21) * 10)
+    hk, _ = solve_blocks_from_dists(d[None])
+    # capacity just over the 4*k*(n-1) floor so compaction pressure is real
+    res = bb.solve(d, capacity=4 * 8 * 11 + 64, k=8, bound="min-out",
+                   mst_prune=False, node_ascent=0, device_loop=True,
+                   max_iters=2_000_000)
+    assert res.proven_optimal
+    assert res.cost == float(hk[0])
+
+
+def test_device_loop_capacity_guard():
+    d = np.rint(random_d(12, 3) * 10)
+    with pytest.raises(ValueError, match="device_loop needs capacity"):
+        bb.solve(d, capacity=64, k=64, device_loop=True)
+
+
+def test_warm_compile_device_solver_smoke():
+    """AOT warm-compile must not execute anything (it exists so benches can
+    exclude compile time without a poisoning warmup run)."""
+    bb.warm_compile_device_solver(12, 1 << 12, 16, True, True, 1)
+
+
+def test_host_incumbent_quality():
+    """strong_incumbent_host (numpy ILS twin) must produce a valid closed
+    tour whose cost matches a re-measure; on burma14 it should land the
+    published optimum like the device version does."""
+    d = burma14().distance_matrix()
+    tour = bb.strong_incumbent_host(d, starts=16)
+    assert tour[0] == tour[-1] == 0
+    assert sorted(tour[:-1].tolist()) == list(range(d.shape[0]))
+    assert bb.tour_cost(np.asarray(d, np.float64), tour) == 3323.0
+
+
+def test_host_ascent_matches_device_root_bound():
+    """The f64 host ascent's certified root bound must be at least as good
+    as (and close to) the published optima for bound-tight instances."""
+    from tsp_mpi_reduction_tpu.ops.one_tree import held_karp_potentials_np, one_tree_value_np
+    d = burma14().distance_matrix()
+    pi, w = held_karp_potentials_np(np.asarray(d, np.float64), steps=400)
+    assert abs(one_tree_value_np(d, pi) - w) < 1e-9
+    assert 3322.0 <= w <= 3323.0  # burma14's HK bound equals its optimum
+
+
+def test_device_ascent_mode_still_proves():
+    """ascent="device" (the f32 jit ascent) remains a supported bound
+    source for the host-loop path."""
+    d = np.rint(random_d(12, 7) * 10)
+    hk, _ = solve_blocks_from_dists(d[None])
+    res = bb.solve(d, capacity=1 << 14, k=64, device_loop=False, ascent="device")
+    assert res.proven_optimal and res.cost == float(hk[0])

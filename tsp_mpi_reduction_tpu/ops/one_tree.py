@@ -117,6 +117,74 @@ def held_karp_potentials(
     return best_pi, best_w
 
 
+def one_tree_np(d64, pi64):
+    """Host float64 1-tree -> (w(pi), degrees). Numpy twin of
+    ``one_tree_cost_degrees`` + the ``- 2*sum(pi)`` correction, used by the
+    host-side ascent (``held_karp_potentials_np``)."""
+    import numpy as np
+
+    d64 = np.asarray(d64, np.float64)
+    pi64 = np.asarray(pi64, np.float64)
+    n = d64.shape[0]
+    dbar = d64 + pi64[:, None] + pi64[None, :]
+    np.fill_diagonal(dbar, np.inf)
+    sub = dbar[1:, 1:]
+    m = n - 1
+    in_tree = np.zeros(m, bool)
+    in_tree[0] = True
+    mindist = sub[0].copy()
+    closest = np.zeros(m, np.int64)
+    deg = np.zeros(n, np.int64)
+    cost = 0.0
+    for _ in range(m - 1):
+        cand = np.where(in_tree, np.inf, mindist)
+        u = int(np.argmin(cand))
+        cost += cand[u]
+        deg[u + 1] += 1
+        deg[closest[u] + 1] += 1
+        in_tree[u] = True
+        better = ~in_tree & (sub[u] < mindist)
+        mindist = np.where(better, sub[u], mindist)
+        closest = np.where(better, u, closest)
+    ends = np.argsort(dbar[0, 1:], kind="stable")[:2]
+    e0 = dbar[0, 1:][ends].sum()
+    deg[0] += 2
+    deg[ends + 1] += 1
+    return float(cost + e0 - 2.0 * pi64.sum()), deg
+
+
+def held_karp_potentials_np(d64, steps: int = 400):
+    """Host float64 subgradient ascent -> (pi, best_w). Numpy twin of
+    ``held_karp_potentials`` (same t0/decay schedule, best-seen tracking).
+
+    Exists so bound setup can run with ZERO device work: on this image's
+    remote-TPU relay the first device->host transfer permanently degrades
+    dispatch latency (see models.branch_bound docstring), so the B&B's
+    fast path must build its bounds without ever touching the device.
+    Also f64 end to end, which the device ascent (f32, Mosaic) is not.
+    """
+    import numpy as np
+
+    d64 = np.asarray(d64, np.float64)
+    n = d64.shape[0]
+    if n < 3:
+        raise ValueError(f"1-tree bound needs n >= 3 cities, got {n}")
+    pi = np.zeros(n)
+    w0, _ = one_tree_np(d64, pi)
+    t0 = max(w0, 1.0) / (2.0 * n)
+    decay = 1e-3 ** (1.0 / max(steps, 1))
+    best_pi, best_w = pi.copy(), -np.inf
+    t = t0
+    for _ in range(steps):
+        w, deg = one_tree_np(d64, pi)
+        if w > best_w:
+            best_w = w
+            best_pi = pi.copy()
+        pi = pi + t * (deg - 2)
+        t *= decay
+    return best_pi, best_w
+
+
 def one_tree_value_np(d64, pi64) -> float:
     """Host float64 re-evaluation of ``w(pi)`` for given potentials.
 
