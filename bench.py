@@ -64,9 +64,9 @@ N, BLOCKS, GRID = 16, 100, 1000
 #: Single-rank CPU B&B nodes/sec on eil51 (this engine, this host,
 #: proven-optimal run, compile excluded) x 8 ranks — i.e. the anchor
 #: generously assumes perfect 8-way MPI scaling of our own CPU rate.
-#: Measured 2026-07-30 at the default engine config (k=256, node_ascent=2):
-#: 7,730 nodes/s, proof in 28.1 s at capacity 1<<17; see BENCHMARKS.md.
-BNB_CPU_8RANK_ANCHOR = 8 * 7730.0
+#: Measured 2026-07-30 at the current engine config (k=1024, node_ascent=2,
+#: f64 host ascent): 16,283 nodes/s, proof in 9.4 s; see BENCHMARKS.md.
+BNB_CPU_8RANK_ANCHOR = 8 * 16283.0
 
 
 def _accelerator_usable(timeout_s: float = 180.0) -> bool:
@@ -168,7 +168,9 @@ def bench_bnb() -> int:
 
 
 def main() -> int:
-    if not _accelerator_usable():
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        pass  # caller pinned CPU; skip the (slow) accelerator probe
+    elif not _accelerator_usable():
         print(
             "bench: no usable accelerator; falling back to CPU "
             "(numbers will not reflect TPU performance)",
@@ -192,7 +194,11 @@ def main() -> int:
     from tsp_mpi_reduction_tpu.ops.distance import distance_matrix
     from tsp_mpi_reduction_tpu.ops.generator import generate_instance
     from tsp_mpi_reduction_tpu.ops.held_karp import build_plan, solve_blocks_from_dists
-    from tsp_mpi_reduction_tpu.ops.merge import fold_tours, fold_tours_tree
+    from tsp_mpi_reduction_tpu.ops.merge import (
+        fold_tours,
+        fold_tours_tree,
+        fold_tours_tree_xy,
+    )
 
     impl = os.environ.get("TSP_TPU_IMPL")  # compact|dense|fused|pallas
     if impl:
@@ -205,16 +211,16 @@ def main() -> int:
     _, xy = generate_instance(N, BLOCKS, GRID, GRID)
     xy32 = jnp.asarray(np.asarray(xy, np.float32))
 
-    def make_step(fold):
+    def make_step(fold, from_xy):
         @jax.jit
         def step(xy_blocks, feedback):
             flat = xy_blocks.reshape(-1, 2)
-            dist = distance_matrix(flat)
             block_d = jax.vmap(distance_matrix)(xy_blocks)
             costs, local_tours = solve_blocks_from_dists(block_d, jnp.float32)
             offsets = (jnp.arange(BLOCKS, dtype=jnp.int32) * N)[:, None]
+            ctx = flat if from_xy else distance_matrix(flat)
             ids, length, cost = fold(
-                local_tours.astype(jnp.int32) + offsets, costs, dist
+                local_tours.astype(jnp.int32) + offsets, costs, ctx
             )
             # feedback*0 threads the previous run's output into this run's
             # input: the M timed runs form one dependency chain, so a
@@ -222,8 +228,8 @@ def main() -> int:
             return cost + feedback * 0.0
         return step
 
-    def timed(name, fold, m):
-        step = make_step(fold)
+    def timed(name, fold, m, from_xy=False):
+        step = make_step(fold, from_xy)
         t0 = time.perf_counter()
         c = step(xy32, jnp.float32(0.0))  # compile+first run; no readback
         jax.block_until_ready(c)
@@ -240,21 +246,26 @@ def main() -> int:
     # the reference's own cross-rank reduce) removes the B-step sequential
     # dependency chain; the scan is the reference's rank-local fold order.
     # The merge operator is non-associative, so their costs legitimately
-    # differ — exactly as the reference's output differs across rank counts.
-    # TSP_BENCH_FOLD=scan|tree pins one. Each fold's chain runs in its own
+    # differ — exactly as the reference's output differs across rank counts
+    # (tree_xy computes identical f32 values to tree, only faster).
+    # TSP_BENCH_FOLD=scan|tree|tree_xy pins one. Each fold's chain runs in its own
     # pre-readback window only for the FIRST fold measured; measuring tree
     # first matters less than it seems — chained dispatches queue before
     # the drain, so per-run time stays true either way.
     pin = os.environ.get("TSP_BENCH_FOLD")
-    if pin not in (None, "tree", "scan"):
+    if pin not in (None, "tree", "tree_xy", "scan"):
         print(
             f"bench: ignoring unrecognized TSP_BENCH_FOLD={pin!r} "
-            "(expected 'tree' or 'scan'); measuring both",
+            "(expected 'tree', 'tree_xy' or 'scan'); measuring all",
             file=sys.stderr,
         )
         pin = None
     m = int(os.environ.get("TSP_BENCH_REPS", "10"))
     results = {}
+    if pin in (None, "tree_xy"):
+        # tree fold with coordinate-computed swap costs (no [N,N] gathers
+        # — the random gathers are scalar-rate on TPU); same f32 values
+        results["tree_xy"] = timed("tree_xy", fold_tours_tree_xy, m, from_xy=True)
     if pin in (None, "tree"):
         results["tree"] = timed("tree", fold_tours_tree, m)
     if pin in (None, "scan"):

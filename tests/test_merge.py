@@ -102,3 +102,31 @@ def test_tree_fold_matches_host_tree_and_is_valid(goldens_dir, name):
     t = np.asarray(ids)[: int(length)]
     assert t[0] == t[-1]
     assert sorted(t[:-1].tolist()) == list(range(n * b))
+
+
+def test_tree_fold_xy_matches_gather_fold():
+    """merge_tours_xy computes swap costs from coordinates with the same
+    formula distance_matrix uses, so the xy tree fold must reproduce the
+    gather tree fold exactly (same f32 values -> same argmin -> same
+    splice) on the same float32 inputs."""
+    from tsp_mpi_reduction_tpu.ops.distance import distance_matrix
+    from tsp_mpi_reduction_tpu.ops.merge import fold_tours_tree, fold_tours_tree_xy
+
+    rng = np.random.default_rng(3)
+    n, b = 6, 7  # odd block count exercises the odd-tour carry path
+    xy = jnp.asarray(rng.uniform(0, 100, (n * b, 2)), jnp.float32)
+    dist = distance_matrix(xy)
+    tours, costs = [], []
+    for i in range(b):
+        perm = rng.permutation(n) + i * n
+        perm = np.roll(perm, -int(np.argmin(perm)))  # start at block min
+        tours.append(np.concatenate([perm, perm[:1]]))
+        d = np.asarray(dist)
+        costs.append(np.float32(d[perm, np.roll(perm, -1)].sum()))
+    tours = jnp.asarray(np.stack(tours), jnp.int32)
+    costs = jnp.asarray(np.stack(costs))
+    a_ids, a_len, a_cost = fold_tours_tree(tours, costs, dist)
+    b_ids, b_len, b_cost = fold_tours_tree_xy(tours, costs, xy)
+    assert int(a_len) == int(b_len)
+    np.testing.assert_array_equal(np.asarray(a_ids), np.asarray(b_ids))
+    assert float(a_cost) == float(b_cost)

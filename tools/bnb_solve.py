@@ -42,6 +42,14 @@ def main() -> int:
         help="per-node mini-ascent steps on the MST bound (0 disables; "
         "each step costs one more vmapped Prim but prunes harder)",
     )
+    ap.add_argument(
+        "--device-loop", default="auto", choices=["auto", "on", "off"],
+        help="run the whole search as one transfer-free device dispatch "
+        "(auto: on for accelerators — required for full speed on the "
+        "remote-TPU relay, whose dispatch degrades after any "
+        "device->host readback)",
+    )
+    ap.add_argument("--max-iters", type=int, default=200_000)
     args = ap.parse_args()
 
     platform = select_backend(args.backend)
@@ -97,6 +105,12 @@ def main() -> int:
     if args.ranks > 1:
         from tsp_mpi_reduction_tpu.parallel.mesh import make_rank_mesh
 
+        if args.device_loop != "auto":
+            print(
+                "note: --device-loop applies to the single-rank solver only; "
+                "the sharded solver always steps per inner batch",
+                file=sys.stderr,
+            )
         res = bb.solve_sharded(
             d,
             make_rank_mesh(args.ranks),
@@ -104,6 +118,7 @@ def main() -> int:
             k=args.k,
             inner_steps=args.inner_steps,
             time_limit_s=args.time_limit,
+            max_iters=args.max_iters,
             bound=args.bound,
             node_ascent=args.node_ascent,
             checkpoint_path=args.checkpoint,
@@ -117,11 +132,13 @@ def main() -> int:
             k=args.k,
             inner_steps=args.inner_steps,
             time_limit_s=args.time_limit,
+            max_iters=args.max_iters,
             checkpoint_path=args.checkpoint,
             checkpoint_every=args.checkpoint_every,
             resume_from=args.resume,
             bound=args.bound,
             node_ascent=args.node_ascent,
+            device_loop={"auto": None, "on": True, "off": False}[args.device_loop],
         )
 
     opt = inst.known_optimum

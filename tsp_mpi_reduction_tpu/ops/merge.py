@@ -56,29 +56,44 @@ class PaddedTour(NamedTuple):
     cost: jnp.ndarray
 
 
+def _tour_edges(t1: PaddedTour, t2: PaddedTour):
+    """Edge endpoint ids of both closed tours: (a, b) = tour-1 edges,
+    (r1, r2) = tour-2 edges, with padding successors clamped to 0."""
+    i1 = jnp.arange(t1.ids.shape[0])
+    i2 = jnp.arange(t2.ids.shape[0])
+    # closed-tour successor: (i+1) mod length, padding lanes clamped to 0
+    nxt1 = jnp.where(i1 + 1 >= t1.length, 0, i1 + 1)
+    nxt2 = jnp.where(i2 + 1 >= t2.length, 0, i2 + 1)
+    return t1.ids, t1.ids[nxt1], t2.ids, t2.ids[nxt2]
+
+
+def _merge_from_sc(t1: PaddedTour, t2: PaddedTour, sc: jnp.ndarray) -> PaddedTour:
+    """Shared merge tail: mask invalid lanes of the [P1, P2] swap-cost
+    matrix, take its first minimum in i-major order (the reference's
+    tie-break), splice, and apply the formulaic cost (tsp.cpp:263:
+    (cost1 + cost2) + bestSwapCost)."""
+    p2 = t2.ids.shape[0]
+    i1 = jnp.arange(t1.ids.shape[0])
+    i2 = jnp.arange(p2)
+    valid = (i1[:, None] < t1.length) & (i2[None, :] < t2.length)
+    sc = jnp.where(valid, sc, jnp.asarray(jnp.inf, sc.dtype))
+
+    flat = jnp.argmin(sc.reshape(-1))  # first minimum in i-major, j-minor order
+    i_star = (flat // p2).astype(jnp.int32)
+    j_star = (flat - i_star * p2).astype(jnp.int32)
+    best_swap = sc.reshape(-1)[flat]
+
+    out, out_len = _splice(t1.ids, t1.length, t2.ids, t2.length, i_star, j_star)
+    return PaddedTour(out, out_len, (t1.cost + t2.cost) + best_swap)
+
+
 def merge_tours(t1: PaddedTour, t2: PaddedTour, dist: jnp.ndarray) -> PaddedTour:
     """Merge ``t2`` into ``t1``; result lives in ``t1``-sized buffer.
 
     Caller must guarantee ``t1.length + t2.length - 1 <= P1`` and both
     operands hold >= 3 distinct cities (see module docstring).
     """
-    p1 = t1.ids.shape[0]
-    p2 = t2.ids.shape[0]
-    ids1, len1, c1 = t1.ids, t1.length, t1.cost
-    ids2, len2, c2 = t2.ids, t2.length, t2.cost
-    dtype = dist.dtype
-    inf = jnp.asarray(jnp.inf, dtype)
-
-    i1 = jnp.arange(p1)
-    i2 = jnp.arange(p2)
-    # closed-tour successor: (i+1) mod length, padding lanes clamped to 0
-    nxt1 = jnp.where(i1 + 1 >= len1, 0, i1 + 1)
-    nxt2 = jnp.where(i2 + 1 >= len2, 0, i2 + 1)
-    a = ids1  # left edge heads
-    b = ids1[nxt1]  # left edge tails
-    r1 = ids2  # right edge heads
-    r2 = ids2[nxt2]  # right edge tails
-
+    a, b, r1, r2 = _tour_edges(t1, t2)
     # swapPairCost (tsp.cpp:197-200), left-to-right addition order:
     # ((d(a, r2) + d(b, r1)) - d(a, b)) - d(r1, r2)
     # d(a,b) depends only on i and d(r1,r2) only on j, so gather those once
@@ -87,28 +102,33 @@ def merge_tours(t1: PaddedTour, t2: PaddedTour, dist: jnp.ndarray) -> PaddedTour
     sc = (
         dist[a[:, None], r2[None, :]] + dist[b[:, None], r1[None, :]] - d_ab[:, None]
     ) - d_r[None, :]
-    valid = (i1[:, None] < len1) & (i2[None, :] < len2)
-    sc = jnp.where(valid, sc, inf)
+    return _merge_from_sc(t1, t2, sc)
 
-    flat = jnp.argmin(sc.reshape(-1))  # first minimum in i-major, j-minor order
-    i_star = (flat // p2).astype(jnp.int32)
-    j_star = (flat - i_star * p2).astype(jnp.int32)
-    best_swap = sc.reshape(-1)[flat]
 
-    # --- splice (tsp.cpp:229-259) ---
+def _splice(ids1, len1, ids2, len2, i_star, j_star):
+    """The reference's splice (tsp.cpp:229-259): insert tour 2, reversed
+    and rotated so the chosen right-edge head lands at the boundary, after
+    the first position of tour 1 whose id matches either endpoint of the
+    chosen left edge. Returns (ids, length) in tour 1's buffer size.
+
+    - The reference rotates until the HEAD VALUE matches the chosen
+      right-edge head cities2[j_star] (tsp.cpp:236-239), i.e. it stops at
+      the FIRST occurrence of that id in the POPPED vector — identical to
+      the positional index on duplicate-free closed tours (where
+      ids2[len2-1] == ids2[0]), but not when ids repeat (possible only
+      under --compat-bugs corrupted operands, SURVEY.md quirk #5).
+    - Value absent from the popped vector => the real reference spins
+      forever (quirk #6 mechanism); fall back to the positional index —
+      we cannot (and should not) emulate a hang.
+    """
+    p1 = ids1.shape[0]
+    p2 = ids2.shape[0]
+    i1 = jnp.arange(p1)
+    i2 = jnp.arange(p2)
     l2p = len2 - 1  # tour 2 with its closing duplicate popped
-    # the reference rotates until the HEAD VALUE matches the chosen
-    # right-edge head cities2[j_star] (tsp.cpp:236-239), i.e. it stops at
-    # the FIRST occurrence of that id in the POPPED vector — identical to
-    # the positional index on duplicate-free closed tours (where
-    # ids2[len2-1] == ids2[0]), but not when ids repeat (possible only
-    # under --compat-bugs corrupted operands, SURVEY.md quirk #5)
     vj = ids2[j_star]
     match2 = (ids2 == vj) & (i2 < l2p)
     first = jnp.argmax(match2).astype(jnp.int32)
-    # value absent from the popped vector => the real reference spins
-    # forever (quirk #6 mechanism); fall back to the positional index —
-    # we cannot (and should not) emulate a hang
     p2_rot = jnp.where(
         match2.any(), first, jnp.where(j_star >= l2p, 0, j_star)
     )
@@ -129,10 +149,7 @@ def merge_tours(t1: PaddedTour, t2: PaddedTour, dist: jnp.ndarray) -> PaddedTour
     idx1 = jnp.where(from_t1_head, t, jnp.maximum(t - l2p, 0))
     out = jnp.where(from_t2, ids2[jnp.clip(src2, 0, p2 - 1)], ids1[jnp.clip(idx1, 0, p1 - 1)])
     out = jnp.where(t < out_len, out, 0).astype(jnp.int32)
-
-    # formulaic cost (tsp.cpp:263): (cost1 + cost2) + bestSwapCost
-    new_cost = (c1 + c2) + best_swap
-    return PaddedTour(out, out_len, new_cost)
+    return out, out_len
 
 
 def make_padded(ids, length, cost, capacity: int) -> PaddedTour:
@@ -184,6 +201,37 @@ def fold_tours(
     return acc.ids, acc.length, acc.cost
 
 
+def merge_tours_xy(
+    t1: PaddedTour, t2: PaddedTour, xy: jnp.ndarray
+) -> PaddedTour:
+    """``merge_tours`` computing distances FROM COORDINATES instead of
+    gathering a resident [N, N] matrix.
+
+    The gather formulation reads ~4*L1*L2 random elements of ``dist`` per
+    merge — scalar-rate loads on TPU that dominate the whole fold. Here
+    each tour's coordinates are gathered once (L rows), and the four
+    distance blocks of the swap cost become broadcasted norm computations
+    (pure VPU math, no random access). Same formula as
+    ``ops.distance.distance_matrix`` in the same dtype, so results match
+    the gather path's f32 values.
+
+    ``xy``: [N, 2] city coordinates in the cost dtype.
+    """
+    from .distance import edge_length
+
+    a, b, r1, r2 = _tour_edges(t1, t2)
+    xa, xb = xy[a], xy[b]  # [p1, 2] — one row gather per tour position
+    x1, x2 = xy[r1], xy[r2]  # [p2, 2]
+    d_ab = edge_length(xa, xb)  # [p1]
+    d_r = edge_length(x1, x2)  # [p2]
+    sc = (
+        edge_length(xa[:, None, :], x2[None, :, :])
+        + edge_length(xb[:, None, :], x1[None, :, :])
+        - d_ab[:, None]
+    ) - d_r[None, :]
+    return _merge_from_sc(t1, t2, sc)
+
+
 def fold_tours_tree(
     tours: jnp.ndarray, costs: jnp.ndarray, dist: jnp.ndarray
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
@@ -212,12 +260,27 @@ def fold_tours_tree(
     larger than the exact final length for non-power-of-two B. Consumers
     must slice by the returned ``length``; entries past it are zero.
     """
+    return _fold_tree(tours, costs, dist, merge_tours)
+
+
+def fold_tours_tree_xy(
+    tours: jnp.ndarray, costs: jnp.ndarray, xy: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """``fold_tours_tree`` built on ``merge_tours_xy``: swap costs are
+    computed from the [N, 2] coordinates instead of gathered from a
+    resident [N, N] matrix — the TPU speed path (the 4*L1*L2 random
+    gathers per merge are scalar-rate on TPU and dominate the fold's wall
+    time; the coordinate form is pure vectorized math)."""
+    return _fold_tree(tours, costs, xy, merge_tours_xy)
+
+
+def _fold_tree(tours, costs, ctx, merge_fn):
     tours = jnp.asarray(tours, jnp.int32)
     b, l = tours.shape
     cur = [
         PaddedTour(tours[i], jnp.asarray(l, jnp.int32), costs[i]) for i in range(b)
     ]
-    vmerge = jax.vmap(merge_tours, in_axes=(0, 0, None))
+    vmerge = jax.vmap(merge_fn, in_axes=(0, 0, None))
     while len(cur) > 1:
         pairs = len(cur) // 2
         # output buffer: every surviving tour padded to the merged size
@@ -228,7 +291,7 @@ def fold_tours_tree(
         left = PaddedTour(
             jnp.pad(left.ids, ((0, 0), (0, pad))), left.length, left.cost
         )
-        merged = vmerge(left, right, dist)
+        merged = vmerge(left, right, ctx)
         nxt = [jax.tree.map(lambda x: x[i], merged) for i in range(pairs)]
         if len(cur) % 2:
             odd = cur[-1]

@@ -73,7 +73,15 @@ def select_backend(name: str = "auto") -> str:
     regs = _registered_platforms()
     accel = [p for p in ACCELERATOR_PLATFORMS if p in regs]
     if name == "auto":
-        name = "tpu" if accel else "cpu"
+        import os
+
+        if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+            # the caller's environment pinned CPU explicitly — don't let a
+            # merely-registered (possibly uninitializable) accelerator
+            # plugin override that pin
+            name = "cpu"
+        else:
+            name = "tpu" if accel else "cpu"
     if name == "cpu":
         force_host_platform()
         return "cpu"
