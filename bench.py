@@ -134,8 +134,9 @@ def bench_bnb() -> int:
         # AOT compile only (no device execution -> the relay stays in fast
         # mode); integral must match what _bound_setup will derive from
         # the data or the timed dispatch recompiles a new static config
-        integral = bool(np.all(np.asarray(d, np.float64) == np.rint(d)))
-        bb.warm_compile_device_solver(n, capacity, k, integral, True, na)
+        bb.warm_compile_device_solver(
+            n, capacity, k, bb._is_integral(d), True, na
+        )
     print(f"warmup (compile): {time.perf_counter() - t0:.1f}s", file=sys.stderr)
 
     res = bb.solve(
@@ -168,8 +169,11 @@ def bench_bnb() -> int:
 
 
 def main() -> int:
-    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
-        pass  # caller pinned CPU; skip the (slow) accelerator probe
+    if (
+        os.environ.get("JAX_PLATFORMS", "").strip() == "cpu"
+        or os.environ.get("TSP_BENCH_PROBED") == "1"
+    ):
+        pass  # CPU pinned, or the parent bench already probed
     elif not _accelerator_usable():
         print(
             "bench: no usable accelerator; falling back to CPU "
@@ -241,41 +245,63 @@ def main() -> int:
         per_run = (time.perf_counter() - t0) * 1000.0 / m
         return per_run, v, compile_s
 
-    # measure BOTH fold shapes and report the faster (disclosed via the
+    # measure the fold shapes and report the faster (disclosed via the
     # "fold" key): the tree (log2(B) vmapped merge rounds — the shape of
     # the reference's own cross-rank reduce) removes the B-step sequential
-    # dependency chain; the scan is the reference's rank-local fold order.
-    # The merge operator is non-associative, so their costs legitimately
-    # differ — exactly as the reference's output differs across rank counts
-    # (tree_xy computes identical f32 values to tree, only faster).
-    # TSP_BENCH_FOLD=scan|tree|tree_xy pins one. Each fold's chain runs in its own
-    # pre-readback window only for the FIRST fold measured; measuring tree
-    # first matters less than it seems — chained dispatches queue before
-    # the drain, so per-run time stays true either way.
+    # dependency chain; tree_xy computes the swap costs from coordinates
+    # (no [N,N] random gathers; same values as tree on CPU, ±1 ULP under
+    # TPU fusion — each fold's cost is printed so a flip is visible); the
+    # scan is the reference's rank-local fold order. The merge operator is
+    # non-associative, so tree and scan costs legitimately differ —
+    # exactly as the reference's output differs across rank counts.
+    # TSP_BENCH_FOLD=scan|tree|tree_xy pins one fold IN THIS process;
+    # without a pin, each fold is measured in its OWN subprocess — the
+    # first readback of a process permanently degrades later dispatches
+    # on the relay (module docstring), so folds measured after another
+    # fold's drain would be biased.
+    folds = {
+        "tree_xy": (fold_tours_tree_xy, True),
+        "tree": (fold_tours_tree, False),
+        "scan": (fold_tours, False),
+    }
     pin = os.environ.get("TSP_BENCH_FOLD")
-    if pin not in (None, "tree", "tree_xy", "scan"):
+    if pin is not None and pin not in folds:
         print(
             f"bench: ignoring unrecognized TSP_BENCH_FOLD={pin!r} "
-            "(expected 'tree', 'tree_xy' or 'scan'); measuring all",
+            f"(expected one of {sorted(folds)}); measuring all",
             file=sys.stderr,
         )
         pin = None
     m = int(os.environ.get("TSP_BENCH_REPS", "10"))
     results = {}
-    if pin in (None, "tree_xy"):
-        # tree fold with coordinate-computed swap costs (no [N,N] gathers
-        # — the random gathers are scalar-rate on TPU); same f32 values
-        results["tree_xy"] = timed("tree_xy", fold_tours_tree_xy, m, from_xy=True)
-    if pin in (None, "tree"):
-        results["tree"] = timed("tree", fold_tours_tree, m)
-    if pin in (None, "scan"):
-        results["scan"] = timed("scan", fold_tours, m)
+    if pin is not None:
+        fold, from_xy = folds[pin]
+        results[pin] = timed(pin, fold, m, from_xy=from_xy)
+    else:
+        import subprocess
+
+        for nm in folds:
+            env = dict(os.environ, TSP_BENCH_FOLD=nm, TSP_BENCH_PROBED="1")
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                capture_output=True, text=True, env=env,
+            )
+            sys.stderr.write(r.stderr)
+            try:
+                child = json.loads(r.stdout.strip().splitlines()[-1])
+                results[nm] = (float(child["value"]), None, None)
+            except (json.JSONDecodeError, IndexError, KeyError):
+                print(f"bench: fold {nm} subprocess failed "
+                      f"(rc={r.returncode})", file=sys.stderr)
+        if not results:
+            return 1
     for nm, (ms, v, cs) in results.items():
-        print(
-            f"{nm}: {ms:.1f} ms/run over {m} chained runs "
-            f"(compile+first {cs:.1f}s, cost={v:.3f})",
-            file=sys.stderr,
-        )
+        if v is not None:
+            print(
+                f"{nm}: {ms:.1f} ms/run over {m} chained runs "
+                f"(compile+first {cs:.1f}s, cost={v:.3f})",
+                file=sys.stderr,
+            )
     best = min(results, key=lambda nm: results[nm][0])
     value = results[best][0]
     plan = build_plan(N)

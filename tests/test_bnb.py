@@ -319,3 +319,61 @@ def test_device_ascent_mode_still_proves():
     hk, _ = solve_blocks_from_dists(d[None])
     res = bb.solve(d, capacity=1 << 14, k=64, device_loop=False, ascent="device")
     assert res.proven_optimal and res.cost == float(hk[0])
+
+
+def test_sharded_device_loop_matches_host_loop():
+    """The device-resident sharded loop (expand + ring balance + incumbent
+    all_gather + compaction inside one dispatch) must walk the SAME search
+    as the per-batch host loop — identical totals and per-rank counts."""
+    d = np.rint(random_d(12, 11) * 10)
+    mesh = make_rank_mesh(8)
+    kw = dict(capacity_per_rank=1 << 12, k=16, inner_steps=4,
+              bound="min-out", mst_prune=False, node_ascent=0,
+              max_iters=2_000_000)
+    host = bb.solve_sharded(d, mesh, device_loop=False, **kw)
+    dev = bb.solve_sharded(d, mesh, device_loop=True, **kw)
+    assert host.proven_optimal and dev.proven_optimal
+    assert host.cost == dev.cost
+    assert host.nodes_expanded == dev.nodes_expanded
+    np.testing.assert_array_equal(host.nodes_per_rank, dev.nodes_per_rank)
+
+
+def test_sharded_device_loop_adversarial_seed_balances():
+    """Work seeded on one rank must diffuse around the ring inside the
+    device-resident loop (no host round trips between rounds)."""
+    d = np.rint(random_d(12, 13) * 10)
+    res = bb.solve_sharded(
+        d, make_rank_mesh(8), capacity_per_rank=1 << 12, k=16, inner_steps=4,
+        bound="min-out", mst_prune=False, node_ascent=0,
+        seed_mode="single-rank", device_loop=True, max_iters=2_000_000,
+    )
+    assert res.proven_optimal
+    assert (res.nodes_per_rank > 0).sum() >= 4
+
+
+def test_sharded_device_loop_tiny_capacity_spills():
+    """An irreducibly full rank must stop the in-dispatch loop intact, be
+    spilled by the host reservoir, and the search must still prove."""
+    d = np.rint(random_d(12, 21) * 10)
+    hk, _ = solve_blocks_from_dists(d[None])
+    res = bb.solve_sharded(
+        d, make_rank_mesh(4), capacity_per_rank=4 * 8 * 11 + 32, k=8,
+        inner_steps=4, bound="min-out", mst_prune=False, node_ascent=0,
+        device_loop=True, max_iters=2_000_000,
+    )
+    assert res.proven_optimal
+    assert res.cost == float(hk[0])
+
+
+def test_final_lower_bound_reporting():
+    """An early-stopped run must report a certified global lower bound
+    (min over open nodes, >= root bound, <= cost); a proven run reports
+    its cost."""
+    d = np.rint(random_d(12, 9) * 10)
+    full = bb.solve(d, capacity=1 << 14, k=64)
+    assert full.proven_optimal and full.lower_bound == full.cost
+    # min-out + 1-iteration budget: stops early with an open frontier
+    part = bb.solve(d, capacity=1 << 14, k=8, inner_steps=1, max_iters=3,
+                    bound="min-out", mst_prune=False, node_ascent=0)
+    assert not part.proven_optimal
+    assert part.root_lower_bound <= part.lower_bound <= part.cost

@@ -80,6 +80,12 @@ class BnBResult:
     #: proven lower bound at the root (1-tree value; min-out sum otherwise) —
     #: reported so callers can state the optimality gap when stopping early
     root_lower_bound: float = -np.inf
+    #: final certified global lower bound: min bound over all still-open
+    #: nodes (device frontier + host reservoir) at stop, or the proven
+    #: cost. Node bounds are monotone down the tree (children inherit the
+    #: parent's strong bound), so this is >= root_lower_bound — on an
+    #: unproven run it shows how far the search actually closed the gap
+    lower_bound: float = -np.inf
     #: per-rank expansion counts (solve_sharded only) — load-balance evidence
     nodes_per_rank: Optional[np.ndarray] = None
     #: seconds spent before the search loop (bound setup + incumbent/ILS
@@ -834,6 +840,29 @@ def _solve_device(
     lossy push — the caller's host-reservoir spill then takes over, so
     capacity pressure never converts into the overflow flag here.
     """
+    return _guarded_expand_steps(
+        fr, inc_cost, inc_tour, d, min_out, bound_adj, dbar, pi, mst_slack,
+        ascent_step, lam_budget, max_steps, k, n, integral, use_mst,
+        node_ascent
+    )
+
+
+def _guarded_expand_steps(
+    fr, inc_cost, inc_tour, d, min_out, bound_adj, dbar, pi, mst_slack,
+    ascent_step, lam_budget, max_steps, k, n, integral, use_mst, node_ascent
+):
+    """Up to ``max_steps`` expansion steps with a PER-STEP capacity guard:
+    compact under pressure, and if compaction cannot get below the
+    pressure line, stop stack-intact (never an overflow-dropping push).
+    Returns ``(frontier', inc_cost', inc_tour', popped, steps_done)``.
+
+    Shared by ``_solve_device`` (single device; ``max_steps`` = whole
+    budget) and the sharded device-resident loop (``max_steps`` =
+    ``inner_steps`` between ring-balance / incumbent collectives). The
+    guard must be per step — a single step grows the stack by up to
+    ``k*(n-1)``, which is exactly the headroom the caller's
+    ``capacity >= 4*k*(n-1)`` precondition reserves.
+    """
     f_cap = fr.path.shape[0]
     headroom = min(f_cap // 4, k * (n - 1))
 
@@ -1209,6 +1238,11 @@ def solve(
         nodes_per_sec=nodes / wall if wall > 0 else 0.0,
         time_to_best=t_best,
         root_lower_bound=root_lb,
+        lower_bound=_final_lower_bound(
+            proven, float(inc_cost), root_lb,
+            [np.asarray(fr.bound[: int(fr.count)])], reservoir,
+            overflow=bool(fr.overflow),
+        ),
         setup_seconds=setup_s,
     )
 
@@ -1231,8 +1265,16 @@ def solve_sharded(
     ils_rounds: Optional[int] = None,
     node_ascent: int = 2,
     ascent: str = "host",
+    device_loop: Optional[bool] = None,
 ) -> BnBResult:
     """Mesh-parallel B&B: per-rank frontiers, collective incumbent sharing.
+
+    ``device_loop``: as in :func:`solve` — run MANY expansion rounds
+    (expand + ring balance + incumbent all_gather + per-rank on-device
+    compaction) inside ONE dispatch, syncing to the host only when the
+    mesh drains, a rank is irreducibly full, or the step budget runs out.
+    Default auto: on for accelerator backends (per-batch host syncs are
+    ruinous through the remote relay), off for CPU meshes.
 
     The north star's architecture realized: each rank expands its own
     padded frontier shard (seeded with a round-robin split of the root's
@@ -1265,6 +1307,18 @@ def solve_sharded(
             f"B&B engine supports 3 <= n <= {MAX_BNB_CITIES} cities, got {n}"
         )
     num_ranks = int(mesh.devices.size)
+    auto_device_loop = device_loop is None
+    if auto_device_loop:
+        device_loop = jax.default_backend() not in ("cpu",)
+    if device_loop and capacity_per_rank < 4 * k * (n - 1):
+        if auto_device_loop:
+            device_loop = False
+        else:
+            raise ValueError(
+                f"device_loop needs capacity_per_rank >= 4*k*(n-1) = "
+                f"{4 * k * (n - 1)} (got {capacity_per_rank}); lower k or "
+                "raise capacity"
+            )
     d32 = jnp.asarray(d, jnp.float32)
     d_np = np.asarray(d, np.float64)
     bd = _bound_setup(d, bound, node_ascent=node_ascent, ascent=ascent)
@@ -1323,7 +1377,14 @@ def solve_sharded(
         # caller's argument must not disarm the spill trigger below
         capacity_per_rank = int(np.asarray(fr_h.path).shape[1])
     else:
-        inc_tour_np = strong_incumbent(d, starts=16, perturbations=ils_rounds)
+        # device_loop: host twin — the device must stay untouched before
+        # the big dispatch (relay fast-mode, see solve())
+        if device_loop:
+            inc_tour_np = strong_incumbent_host(
+                d, starts=16, perturbations=ils_rounds
+            )
+        else:
+            inc_tour_np = strong_incumbent(d, starts=16, perturbations=ils_rounds)
         inc_cost0 = tour_cost(d_np, inc_tour_np)
         fr = Frontier(
             *(jax.device_put(np.stack(leaves[f]), spec) for f in Frontier._fields)
@@ -1408,6 +1469,95 @@ def solve_sharded(
         )
     )
 
+    # the device-resident outer loop (device_loop mode): MANY rounds of
+    # [inner_steps guarded expansion steps -> ring balance -> incumbent
+    # all_gather] run inside ONE dispatch. Each round's expansion is
+    # _guarded_expand_steps — the same per-step compaction/full-stop
+    # machinery as _solve_device, so a rank can never overflow-drop
+    # (growth per step <= k*(n-1) = the reserved headroom). A round also
+    # computes a `done` flag (mesh drained, a rank irreducibly full ->
+    # host must spill, or overflow tripped) consumed by the while cond
+    # NEXT iteration, keeping collectives out of cond.
+    loop_headroom = min(capacity_per_rank // 4, k * (n - 1))
+
+    def rank_body_loop(fr_stacked, ic_l, itour_l, d_rep, mo_rep, ba_rep,
+                       dbar_rep, pi_rep, slack_rep, step_rep, budget_rep,
+                       max_rounds_rep):
+        local = Frontier(*(x[0] for x in fr_stacked))
+
+        def cond(c):
+            _, _, _, _, i, done = c
+            return (i < max_rounds_rep) & ~done
+
+        def body(c):
+            fr, icc, itc, nds, i, _ = c
+            fr, icc, itc, dn, _ = _guarded_expand_steps(
+                fr, icc, itc, d_rep, mo_rep, ba_rep, dbar_rep, pi_rep,
+                slack_rep, step_rep, budget_rep, jnp.asarray(inner_steps),
+                k, n, integral, mst_prune, node_ascent
+            )
+            if num_ranks > 1:
+                fr = ring_balance(fr)
+            all_c = jax.lax.all_gather(icc, RANK_AXIS)
+            all_t = jax.lax.all_gather(itc, RANK_AXIS)
+            sel = jnp.argmin(all_c)
+            icc, itc = all_c[sel], all_t[sel]
+            full = fr.count > capacity_per_rank - loop_headroom
+            stop = full | fr.overflow
+            any_stop = jax.lax.psum(stop.astype(jnp.int32), RANK_AXIS) > 0
+            total = jax.lax.psum(fr.count, RANK_AXIS)
+            # psum/all-reduce results are axis-invariant; the carry slot was
+            # initialized from a varying value, so re-mark it varying
+            done = jax.lax.pcast(
+                (total == 0) | any_stop, RANK_AXIS, to="varying"
+            )
+            return fr, icc, itc, nds + dn, i + 1, done
+
+        zero = local.count * 0
+        fr, icc, itc, nds, steps, _ = jax.lax.while_loop(
+            cond, body,
+            (local, ic_l[0], itour_l[0], zero, zero, local.count < 0),
+        )
+        total_nodes = jax.lax.psum(nds, RANK_AXIS)
+        rank_nodes = jax.lax.all_gather(nds, RANK_AXIS)
+        return (
+            jax.tree.map(lambda x: x[None], tuple(fr)),
+            icc[None],
+            itc[None],
+            total_nodes[None],
+            rank_nodes[None],
+            steps[None],
+        )
+
+    step_loop = jax.jit(
+        shard_map(
+            rank_body_loop,
+            mesh=mesh,
+            in_specs=(
+                tuple(P(RANK_AXIS) for _ in Frontier._fields),
+                P(RANK_AXIS),
+                P(RANK_AXIS),
+                P(None, None),
+                P(None),
+                P(None),
+                P(None, None),
+                P(None),
+                P(),
+                P(),
+                P(),
+                P(),
+            ),
+            out_specs=(
+                tuple(P(RANK_AXIS) for _ in Frontier._fields),
+                P(RANK_AXIS),
+                P(RANK_AXIS),
+                P(RANK_AXIS),
+                P(RANK_AXIS),
+                P(RANK_AXIS),
+            ),
+        )
+    )
+
     # per-rank host reservoirs: the sharded analog of solve()'s overflow
     # spill — a rank whose stack nears capacity sheds its worst-bound
     # bottom half to the host; when the whole mesh drains, spilled nodes
@@ -1460,13 +1610,27 @@ def solve_sharded(
     rank_nodes = np.zeros(num_ranks, np.int64)
     total0 = 1
     while it < max_iters:
-        out = step(tuple(fr), ic, itour, d32, min_out, bound_adj, bd.dbar,
-                   bd.pi, bd.slack, bd.ascent_step, bd.lam_budget)
+        if device_loop:
+            # round budget: each in-dispatch round runs inner_steps
+            # expansion steps; cap so the int32 node counters (local and
+            # psum'd) cannot overflow within one dispatch
+            rounds = max(1, min(
+                (max_iters - it) // max(inner_steps, 1),
+                (2**31 - 1) // max(k * max(inner_steps, 1) * num_ranks, 1),
+            ))
+            out = step_loop(tuple(fr), ic, itour, d32, min_out, bound_adj,
+                            bd.dbar, bd.pi, bd.slack, bd.ascent_step,
+                            bd.lam_budget, jnp.asarray(rounds, jnp.int32))
+            rounds_done = max(int(out[5][0]), 1)
+        else:
+            out = step(tuple(fr), ic, itour, d32, min_out, bound_adj, bd.dbar,
+                       bd.pi, bd.slack, bd.ascent_step, bd.lam_budget)
+            rounds_done = 1
         fr = Frontier(*out[0])
         ic, itour, step_nodes = out[1], out[2], out[3]
         rank_nodes = rank_nodes + np.asarray(out[4][0])
         nodes += int(step_nodes[0])
-        it += inner_steps
+        it += rounds_done * inner_steps
         best = float(ic[0])
         if best < last_inc:
             last_inc = best
@@ -1493,6 +1657,9 @@ def solve_sharded(
     if checkpoint_path and not proven:
         save(checkpoint_path, fr, ic, itour, d=d, bound=bound,
              num_ranks=num_ranks, reservoir=_merge_reservoirs(reservoirs))
+    counts = np.asarray(fr.count)
+    bounds_h = np.asarray(fr.bound)
+    merged_res = _merge_reservoirs(reservoirs) or _Reservoir()
     return BnBResult(
         cost=float(ic[0]),
         tour=np.asarray(itour)[0],
@@ -1503,9 +1670,49 @@ def solve_sharded(
         nodes_per_sec=nodes / wall if wall > 0 else 0.0,
         time_to_best=t_best,
         root_lower_bound=root_lb,
+        lower_bound=_final_lower_bound(
+            proven, float(ic[0]), root_lb,
+            [bounds_h[r, : int(counts[r])] for r in range(num_ranks)],
+            merged_res,
+            overflow=overflow,
+        ),
         nodes_per_rank=rank_nodes,
         setup_seconds=setup_s,
     )
+
+
+def _is_integral(d) -> bool:
+    """True iff every distance is integer-valued — the predicate that
+    selects the fixed-point-exact f32 path (_bound_setup) and the static
+    ``integral`` kernel config. Single source of truth: benches that
+    AOT-compile the kernel must derive the flag the same way."""
+    d64 = np.asarray(d, np.float64)
+    return bool(np.all(d64 == np.rint(d64)))
+
+
+def _final_lower_bound(
+    proven: bool, cost: float, root_lb: float, open_bounds, reservoir,
+    overflow: bool = False,
+) -> float:
+    """Certified global lower bound at stop: the proven cost, or the min
+    bound over every still-open node (device frontier slices passed in
+    ``open_bounds`` + host reservoir), floored at the root bound and
+    capped at the incumbent.
+
+    ``overflow``: the in-kernel overflow flag tripped, i.e. children were
+    DROPPED — the surviving open set no longer covers the search space,
+    so min-over-survivors is not a valid bound; fall back to the root
+    bound (always certified)."""
+    if proven:
+        return cost
+    if overflow:
+        return min(root_lb, cost)
+    mins = [float(b.min()) for b in open_bounds if b.size]
+    for chunk in reservoir.chunks:
+        if chunk["bound"].size:
+            mins.append(float(chunk["bound"].min()))
+    lb = min(mins) if mins else cost
+    return min(max(lb, root_lb), cost)
 
 
 def _spill_headroom(capacity: int, inner_steps: int, k: int, n: int) -> int:

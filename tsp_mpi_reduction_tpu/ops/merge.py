@@ -211,9 +211,13 @@ def merge_tours_xy(
     merge — scalar-rate loads on TPU that dominate the whole fold. Here
     each tour's coordinates are gathered once (L rows), and the four
     distance blocks of the swap cost become broadcasted norm computations
-    (pure VPU math, no random access). Same formula as
-    ``ops.distance.distance_matrix`` in the same dtype, so results match
-    the gather path's f32 values.
+    (pure VPU math, no random access). Distances use the same
+    ``ops.distance.edge_length`` formula in the same dtype — verified
+    bit-identical to the gather path on CPU (tests/test_merge.py). On TPU
+    the inline recompute sits in a different fusion context than the
+    standalone distance_matrix kernel, so XLA's FMA contraction may round
+    individual distances +-1 ULP (ops/distance.py docstring) and flip an
+    argmin tie; treat TPU results as equivalent-quality, not bit-equal.
 
     ``xy``: [N, 2] city coordinates in the cost dtype.
     """
