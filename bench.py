@@ -282,10 +282,15 @@ def main() -> int:
 
         for nm in folds:
             env = dict(os.environ, TSP_BENCH_FOLD=nm, TSP_BENCH_PROBED="1")
-            r = subprocess.run(
-                [sys.executable, os.path.abspath(__file__)],
-                capture_output=True, text=True, env=env,
-            )
+            try:
+                r = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__)],
+                    capture_output=True, text=True, env=env, timeout=1200,
+                )
+            except subprocess.TimeoutExpired:
+                # a lapsed chip grant hangs a fresh client init forever
+                print(f"bench: fold {nm} subprocess timed out", file=sys.stderr)
+                continue
             sys.stderr.write(r.stderr)
             try:
                 child = json.loads(r.stdout.strip().splitlines()[-1])

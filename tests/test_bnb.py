@@ -377,3 +377,29 @@ def test_final_lower_bound_reporting():
                     bound="min-out", mst_prune=False, node_ascent=0)
     assert not part.proven_optimal
     assert part.root_lower_bound <= part.lower_bound <= part.cost
+
+
+@pytest.mark.slow
+def test_chunked_driver_resumes_across_processes(tmp_path):
+    """tools/bnb_chunked.py: each chunk a fresh subprocess resuming from
+    checkpoint (the relay-poison workaround for long runs) — a tiny
+    per-chunk budget must still converge to a proven optimum."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    tool = os.path.join(os.path.dirname(__file__), "..", "tools", "bnb_chunked.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    r = subprocess.run(
+        [sys.executable, tool, "burma14", "--chunk-iters=60", "--max-chunks=10",
+         f"--checkpoint={tmp_path}/c.npz", "--k=64", "--capacity=8192",
+         "--bound=min-out"],
+        capture_output=True, text=True, env=env, timeout=540,
+    )
+    assert r.returncode == 0, r.stderr[-1500:]
+    lines = [json.loads(x) for x in r.stdout.strip().splitlines()]
+    summary = lines[-1]
+    assert summary["proven_optimal"] and summary["cost"] == 3323.0
+    assert summary["chunks"] >= 2  # genuinely resumed at least once

@@ -1,0 +1,86 @@
+"""Long-run B&B driver: chunks of search in FRESH subprocesses.
+
+Why: on this image's remote-TPU relay, a process's first device->host
+readback permanently degrades every later dispatch (~65 ms per while-loop
+iteration — see models/branch_bound.py). A single process can therefore
+run only ONE full-speed device dispatch: the readback that ends chunk 1
+would cripple chunk 2. This driver gives every chunk its own process —
+`bnb_solve.py --device-loop on` with checkpoint/resume — so each chunk
+runs in the relay's fast mode; the persistent compilation cache makes the
+per-chunk compile a cache hit after the first.
+
+Usage:
+    python tools/bnb_chunked.py kroA100 --chunk-iters=200000 \
+        --max-chunks=20 --time-limit=1200 [bnb_solve args passed through]
+
+Prints one JSON line per chunk (bnb_solve's output) and a final summary.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("instance")
+    ap.add_argument("--chunk-iters", type=int, default=200_000,
+                    help="expansion-step budget per chunk (= subprocess)")
+    ap.add_argument("--max-chunks", type=int, default=10)
+    ap.add_argument("--time-limit", type=float, default=None,
+                    help="total wall budget across chunks (seconds)")
+    ap.add_argument("--checkpoint", default=None,
+                    help="checkpoint path (default: a temp file)")
+    args, passthrough = ap.parse_known_args()
+
+    ckpt = args.checkpoint or os.path.join(
+        tempfile.mkdtemp(prefix="bnb_chunked_"), "chunk.npz"
+    )
+    tool = os.path.join(os.path.dirname(os.path.abspath(__file__)), "bnb_solve.py")
+    t0 = time.perf_counter()
+    last = None
+    for chunk in range(1, args.max_chunks + 1):
+        cmd = [
+            sys.executable, tool, args.instance,
+            "--device-loop=on", f"--max-iters={args.chunk_iters}",
+            f"--checkpoint={ckpt}",
+        ]
+        if os.path.exists(ckpt):
+            cmd.append(f"--resume={ckpt}")
+        cmd += passthrough
+        r = subprocess.run(cmd, capture_output=True, text=True)
+        sys.stderr.write(r.stderr[-2000:])
+        line = r.stdout.strip().splitlines()[-1] if r.stdout.strip() else ""
+        if r.returncode != 0 or not line.startswith("{"):
+            print(f"chunk {chunk}: solver failed rc={r.returncode}",
+                  file=sys.stderr)
+            return 1
+        last = json.loads(line)
+        print(line)
+        elapsed = time.perf_counter() - t0
+        if last["proven_optimal"]:
+            break
+        if args.time_limit is not None and elapsed > args.time_limit:
+            break
+    assert last is not None
+    print(json.dumps({
+        "summary": True,
+        "instance": last["instance"],
+        "chunks": chunk,
+        "cost": last["cost"],
+        "proven_optimal": last["proven_optimal"],
+        "lower_bound": last["lower_bound"],
+        "gap": last["gap"],
+        "total_wall_s": round(time.perf_counter() - t0, 1),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -312,7 +312,7 @@ def _bound_setup(
     """
     n = d.shape[0]
     d64 = np.asarray(d, np.float64)
-    integral = bool(np.all(d64 == np.rint(d64)))
+    integral = _is_integral(d64)
     eye = np.eye(n, dtype=bool)
     if bound == "one-tree":
         if ascent == "host":
@@ -1173,8 +1173,12 @@ def solve(
         if device_loop:
             # per-dispatch step cap keeps the device-side int32 node
             # counter (up to k nodes/step) from ever overflowing; the
-            # Python accumulators below are arbitrary-precision
+            # Python accumulators below are arbitrary-precision. Periodic
+            # checkpointing requires returning to the host, so it also
+            # caps the dispatch.
             budget = min(max_iters - it, (2**31 - 1) // max(k, 1))
+            if checkpoint_every and checkpoint_path:
+                budget = min(budget, max(checkpoint_every, 1))
             fr, inc_cost, inc_tour, popped, steps = _solve_device(
                 fr, inc_cost, inc_tour, d32, min_out, bound_adj, bd.dbar,
                 bd.pi, bd.slack, bd.ascent_step, bd.lam_budget,
@@ -1374,8 +1378,18 @@ def solve_sharded(
         itour = jax.device_put(np.asarray(itour_h), spec)
         inc_cost0 = float(np.asarray(ic_h)[0])
         # the restored arrays define the true per-rank capacity — the
-        # caller's argument must not disarm the spill trigger below
+        # caller's argument must not disarm the spill trigger below (and
+        # the device_loop floor must re-check against THIS capacity)
         capacity_per_rank = int(np.asarray(fr_h.path).shape[1])
+        if device_loop and capacity_per_rank < 4 * k * (n - 1):
+            if auto_device_loop:
+                device_loop = False
+            else:
+                raise ValueError(
+                    f"device_loop needs capacity_per_rank >= 4*k*(n-1) = "
+                    f"{4 * k * (n - 1)}, but checkpoint {resume_from!r} was "
+                    f"written at capacity {capacity_per_rank}; lower k"
+                )
     else:
         # device_loop: host twin — the device must stay untouched before
         # the big dispatch (relay fast-mode, see solve())
@@ -1613,11 +1627,16 @@ def solve_sharded(
         if device_loop:
             # round budget: each in-dispatch round runs inner_steps
             # expansion steps; cap so the int32 node counters (local and
-            # psum'd) cannot overflow within one dispatch
+            # psum'd) cannot overflow within one dispatch, and so periodic
+            # checkpointing (which needs the host) still happens
             rounds = max(1, min(
                 (max_iters - it) // max(inner_steps, 1),
                 (2**31 - 1) // max(k * max(inner_steps, 1) * num_ranks, 1),
             ))
+            if checkpoint_every and checkpoint_path:
+                rounds = max(
+                    1, min(rounds, checkpoint_every // max(inner_steps, 1))
+                )
             out = step_loop(tuple(fr), ic, itour, d32, min_out, bound_adj,
                             bd.dbar, bd.pi, bd.slack, bd.ascent_step,
                             bd.lam_budget, jnp.asarray(rounds, jnp.int32))
