@@ -37,11 +37,28 @@ def main() -> int:
                     help="total wall budget across chunks (seconds)")
     ap.add_argument("--checkpoint", default=None,
                     help="checkpoint path (default: a temp file)")
+    ap.add_argument("--resume-existing", action="store_true",
+                    help="continue from a pre-existing checkpoint at "
+                    "--checkpoint instead of refusing it")
+    ap.add_argument("--chunk-timeout", type=float, default=3600.0,
+                    help="hard per-chunk wall cap (a lapsed chip grant "
+                    "can hang a fresh client init forever)")
     args, passthrough = ap.parse_known_args()
+    if args.max_chunks < 1:
+        ap.error("--max-chunks must be >= 1")
 
     ckpt = args.checkpoint or os.path.join(
         tempfile.mkdtemp(prefix="bnb_chunked_"), "chunk.npz"
     )
+    ckpt_real = ckpt if ckpt.endswith(".npz") else ckpt + ".npz"
+    if os.path.exists(ckpt_real) and not args.resume_existing:
+        print(
+            f"error: checkpoint {ckpt_real!r} already exists — a fresh run "
+            "would silently continue it; pass --resume-existing to do that "
+            "intentionally, or remove the file",
+            file=sys.stderr,
+        )
+        return 2
     tool = os.path.join(os.path.dirname(os.path.abspath(__file__)), "bnb_solve.py")
     t0 = time.perf_counter()
     last = None
@@ -51,10 +68,23 @@ def main() -> int:
             "--device-loop=on", f"--max-iters={args.chunk_iters}",
             f"--checkpoint={ckpt}",
         ]
-        if os.path.exists(ckpt):
+        if os.path.exists(ckpt_real):
             cmd.append(f"--resume={ckpt}")
+        if args.time_limit is not None:
+            # remaining wall budget is enforced inside the chunk too
+            # (coarsely: between its device dispatches)
+            remaining = args.time_limit - (time.perf_counter() - t0)
+            cmd.append(f"--time-limit={max(remaining, 1.0)}")
         cmd += passthrough
-        r = subprocess.run(cmd, capture_output=True, text=True)
+        try:
+            r = subprocess.run(
+                cmd, capture_output=True, text=True,
+                timeout=args.chunk_timeout,
+            )
+        except subprocess.TimeoutExpired:
+            print(f"chunk {chunk}: timed out after {args.chunk_timeout:.0f}s",
+                  file=sys.stderr)
+            return 1
         sys.stderr.write(r.stderr[-2000:])
         line = r.stdout.strip().splitlines()[-1] if r.stdout.strip() else ""
         if r.returncode != 0 or not line.startswith("{"):

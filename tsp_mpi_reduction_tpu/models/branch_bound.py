@@ -128,15 +128,25 @@ def _close_from_zero(open_tour: np.ndarray) -> np.ndarray:
 
 
 def strong_incumbent(
-    d: np.ndarray, starts: int = 8, perturbations: Optional[int] = None
+    d: np.ndarray,
+    starts: int = 8,
+    perturbations: Optional[int] = None,
+    device=None,
 ) -> np.ndarray:
     """Best of ``starts`` nearest-neighbor tours, each polished by the
-    device 2-opt + Or-opt kernels in one vmapped batch (ops.local_search),
+    2-opt + Or-opt kernels in one vmapped batch (ops.local_search),
     followed by ``perturbations`` rounds of iterated local search (batched
     double-bridge kicks + re-polish — the classic escape from 2-opt local
     minima). ``perturbations=None`` auto-selects: 30 rounds for n >= 30
     (a few seconds that routinely land the published TSPLIB optimum),
     else 0.
+
+    ``device``: pin the polish kernels to a specific jax device. The
+    transfer-free accelerator path passes the CPU backend's device here:
+    CPU-client buffers never touch the remote relay, so reading results
+    back does NOT trip its slow dispatch mode, while keeping the full
+    2-opt + Or-opt polish quality (the numpy twin strong_incumbent_host
+    has no Or-opt and is measurably weaker at n >= 100).
 
     Returns a closed [n+1] tour rotated to start at city 0. Costs are
     re-measured on host in float64, so the incumbent fed to the pruner is
@@ -150,12 +160,19 @@ def strong_incumbent(
     if n < 4:
         perturbations = 0  # double-bridge needs 3 distinct interior cuts
     d64 = np.asarray(d, np.float64)
-    d32 = jnp.asarray(d, jnp.float32)
+
+    def put(x, dtype):
+        arr = np.asarray(x, dtype)
+        if device is not None:
+            return jax.device_put(arr, device)
+        return jnp.asarray(arr)
+
+    d32 = put(d, np.float32)
     vpolish = jax.jit(jax.vmap(lambda t: polish(t, d32)[0]))
 
     ss = sorted(set(np.linspace(0, n - 1, min(starts, n)).astype(int).tolist()))
     opens = np.stack([nearest_neighbor_tour(d64, s)[:-1] for s in ss])
-    polished = np.asarray(vpolish(jnp.asarray(opens, jnp.int32)))
+    polished = np.asarray(vpolish(put(opens, np.int32)))
     costs = [tour_cost(d64, np.concatenate([t, t[:1]])) for t in polished]
     best = polished[int(np.argmin(costs))]
     best_cost = float(np.min(costs))
@@ -164,7 +181,7 @@ def strong_incumbent(
     batch = polished.shape[0]
     for _ in range(perturbations):
         kicks = [_double_bridge(rng, best, n) for _ in range(batch)]
-        repolished = np.asarray(vpolish(jnp.asarray(np.stack(kicks), jnp.int32)))
+        repolished = np.asarray(vpolish(put(np.stack(kicks), np.int32)))
         rcosts = [
             tour_cost(d64, np.concatenate([t, t[:1]])) for t in repolished
         ]
@@ -244,12 +261,13 @@ class BoundData(NamedTuple):
 def strong_incumbent_host(
     d: np.ndarray, starts: int = 8, perturbations: Optional[int] = None
 ) -> np.ndarray:
-    """Pure-host twin of ``strong_incumbent``: multistart NN + numpy 2-opt
-    + sequential double-bridge ILS. Same contract (closed [n+1] tour from
-    city 0), ZERO device work — required by the transfer-free device-loop
-    path (module docstring: on the remote-TPU relay the first
-    device->host transfer permanently degrades dispatch latency, so
-    everything before the big device dispatch must stay on host)."""
+    """Pure-numpy twin of ``strong_incumbent``: multistart NN + numpy
+    2-opt + sequential double-bridge ILS. Same contract (closed [n+1]
+    tour from city 0), zero jax work. NOTE: no Or-opt — measurably weaker
+    than ``strong_incumbent`` at n >= 100; the solvers' transfer-free
+    paths therefore use ``strong_incumbent(device=<cpu backend>)``
+    instead (CPU-client readbacks don't trip the relay's slow mode), and
+    this twin remains as the jax-free fallback/reference."""
     n = d.shape[0]
     if perturbations is None:
         perturbations = 30 if n >= 30 else 0
@@ -1120,6 +1138,13 @@ def solve(
                 f"device_loop needs capacity >= 4*k*(n-1) = {4 * k * (n - 1)} "
                 f"(got {capacity}); lower k or raise capacity"
             )
+    # must run BEFORE the first jax array op: it may still widen the
+    # platform pin to make the CPU backend available (utils.backend)
+    cpu_dev = None
+    if device_loop:
+        from ..utils.backend import cpu_fallback_device
+
+        cpu_dev = cpu_fallback_device()
     d32 = jnp.asarray(d, jnp.float32)
     bd = _bound_setup(d, bound, node_ascent=node_ascent, ascent=ascent)
     min_out, bound_adj, root_lb, integral = bd.min_out, bd.bound_adj, bd.root_lb, bd.integral
@@ -1147,14 +1172,20 @@ def solve(
         # ILS kicks (auto for larger n): a few seconds of setup that
         # routinely lands the published optimum as the incumbent, which the
         # ceil-aware pruner then converts into massive savings. The
-        # device-loop path uses the pure-host twin: the device must stay
-        # untouched until the big dispatch (see device_loop above).
-        if device_loop:
+        # device-loop path pins the polish kernels to the CPU backend: the
+        # accelerator must stay untouched until the big dispatch (see
+        # device_loop above), and CPU-client readbacks don't trip the
+        # relay's slow mode. If no CPU backend exists in this process,
+        # fall back to the (Or-opt-less) numpy twin rather than poisoning.
+        if device_loop and cpu_dev is None:
             inc_tour_np = strong_incumbent_host(
                 d, starts=16, perturbations=ils_rounds
             )
         else:
-            inc_tour_np = strong_incumbent(d, starts=16, perturbations=ils_rounds)
+            inc_tour_np = strong_incumbent(
+                d, starts=16, perturbations=ils_rounds,
+                device=cpu_dev if device_loop else None,
+            )
         inc_cost = jnp.asarray(
             tour_cost(np.asarray(d, np.float64), inc_tour_np), jnp.float32
         )
@@ -1323,6 +1354,12 @@ def solve_sharded(
                 f"{4 * k * (n - 1)} (got {capacity_per_rank}); lower k or "
                 "raise capacity"
             )
+    # must run BEFORE the first jax array op (see solve())
+    cpu_dev = None
+    if device_loop:
+        from ..utils.backend import cpu_fallback_device
+
+        cpu_dev = cpu_fallback_device()
     d32 = jnp.asarray(d, jnp.float32)
     d_np = np.asarray(d, np.float64)
     bd = _bound_setup(d, bound, node_ascent=node_ascent, ascent=ascent)
@@ -1391,14 +1428,18 @@ def solve_sharded(
                     f"written at capacity {capacity_per_rank}; lower k"
                 )
     else:
-        # device_loop: host twin — the device must stay untouched before
-        # the big dispatch (relay fast-mode, see solve())
-        if device_loop:
+        # device_loop: polish on the CPU backend — the accelerator must
+        # stay untouched before the big dispatch (relay fast-mode; CPU
+        # readbacks don't trip it; numpy-twin fallback, see solve())
+        if device_loop and cpu_dev is None:
             inc_tour_np = strong_incumbent_host(
                 d, starts=16, perturbations=ils_rounds
             )
         else:
-            inc_tour_np = strong_incumbent(d, starts=16, perturbations=ils_rounds)
+            inc_tour_np = strong_incumbent(
+                d, starts=16, perturbations=ils_rounds,
+                device=cpu_dev if device_loop else None,
+            )
         inc_cost0 = tour_cost(d_np, inc_tour_np)
         fr = Frontier(
             *(jax.device_put(np.stack(leaves[f]), spec) for f in Frontier._fields)

@@ -101,7 +101,11 @@ def select_backend(name: str = "auto") -> str:
         prev_platforms = jax.config.jax_platforms
         last_err: Exception | None = None
         for p in candidates:
-            jax.config.update("jax_platforms", p)
+            # keep the CPU backend available alongside the accelerator
+            # (first entry = default platform): host-pinned compute like
+            # the incumbent polish needs jax.local_devices(backend="cpu"),
+            # which raises if jax_platforms filtered CPU out at init
+            jax.config.update("jax_platforms", f"{p},cpu")
             try:
                 devs = jax.devices()
             except Exception as e:  # plugin registered but chip unreachable
@@ -122,6 +126,30 @@ def select_backend(name: str = "auto") -> str:
             f"no accelerator platform initialized (tried {candidates}): {last_err}"
         )
     raise ValueError(f"unknown backend {name!r} (expected cpu|tpu|auto)")
+
+
+def cpu_fallback_device():
+    """The CPU backend's first device, or None if this process's platform
+    pin excluded CPU and backends are already initialized.
+
+    Called BEFORE the first jax array op, it can still widen the platform
+    list (``jax_platforms`` is only consumed at backend init), so callers
+    that want host-pinned compute should acquire the device early.
+    """
+    try:
+        return jax.local_devices(backend="cpu")[0]
+    except RuntimeError:
+        pass
+    from jax._src import xla_bridge as xb
+
+    cur = jax.config.jax_platforms
+    if cur and "cpu" not in str(cur).split(",") and not xb._backends:
+        try:
+            jax.config.update("jax_platforms", f"{cur},cpu")
+            return jax.local_devices(backend="cpu")[0]
+        except RuntimeError:
+            jax.config.update("jax_platforms", cur)
+    return None
 
 
 def enable_persistent_cache(platform: str) -> None:
