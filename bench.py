@@ -184,13 +184,29 @@ def main() -> int:
 
         select_backend("cpu")
 
+    bnb_mode = os.environ.get("TSP_BENCH", "pipeline") == "bnb"
+    fold_pin = os.environ.get("TSP_BENCH_FOLD")
+    if not bnb_mode and fold_pin is not None and fold_pin not in VALID_FOLDS:
+        print(
+            f"bench: ignoring unrecognized TSP_BENCH_FOLD={fold_pin!r} "
+            f"(expected one of {VALID_FOLDS}); measuring all",
+            file=sys.stderr,
+        )
+        fold_pin = None
+    if not bnb_mode and fold_pin is None:
+        # PARENT SPAWNER: each fold is measured in its own subprocess
+        # (see the methodology comment below). The parent must NOT
+        # initialize a jax backend — the remote-TPU claim is exclusive
+        # per process, so a parent holding it would deadlock every child.
+        return _spawn_fold_children()
+
     from tsp_mpi_reduction_tpu.utils.backend import enable_persistent_cache
 
     import jax
 
     enable_persistent_cache(jax.default_backend())
 
-    if os.environ.get("TSP_BENCH", "pipeline") == "bnb":
+    if bnb_mode:
         return bench_bnb()
     import jax.numpy as jnp
 
@@ -245,85 +261,85 @@ def main() -> int:
         per_run = (time.perf_counter() - t0) * 1000.0 / m
         return per_run, v, compile_s
 
-    # measure the fold shapes and report the faster (disclosed via the
-    # "fold" key): the tree (log2(B) vmapped merge rounds — the shape of
-    # the reference's own cross-rank reduce) removes the B-step sequential
-    # dependency chain; tree_xy computes the swap costs from coordinates
-    # (no [N,N] random gathers; same values as tree on CPU, ±1 ULP under
-    # TPU fusion — each fold's cost is printed so a flip is visible); the
-    # scan is the reference's rank-local fold order. The merge operator is
-    # non-associative, so tree and scan costs legitimately differ —
-    # exactly as the reference's output differs across rank counts.
-    # TSP_BENCH_FOLD=scan|tree|tree_xy pins one fold IN THIS process;
-    # without a pin, each fold is measured in its OWN subprocess — the
-    # first readback of a process permanently degrades later dispatches
-    # on the relay (module docstring), so folds measured after another
-    # fold's drain would be biased.
+    # CHILD: measure the one fold this process is pinned to (see
+    # _spawn_fold_children for why folds are process-isolated): the tree
+    # (log2(B) vmapped merge rounds — the shape of the reference's own
+    # cross-rank reduce) removes the B-step sequential dependency chain;
+    # tree_xy computes the swap costs from coordinates (no [N,N] random
+    # gathers; same values as tree on CPU, ±1 ULP under TPU fusion — the
+    # cost is printed so a flip is visible); the scan is the reference's
+    # rank-local fold order. The merge operator is non-associative, so
+    # tree and scan costs legitimately differ — exactly as the
+    # reference's output differs across rank counts.
     folds = {
         "tree_xy": (fold_tours_tree_xy, True),
         "tree": (fold_tours_tree, False),
         "scan": (fold_tours, False),
     }
-    pin = os.environ.get("TSP_BENCH_FOLD")
-    if pin is not None and pin not in folds:
-        print(
-            f"bench: ignoring unrecognized TSP_BENCH_FOLD={pin!r} "
-            f"(expected one of {sorted(folds)}); measuring all",
-            file=sys.stderr,
-        )
-        pin = None
+    assert tuple(folds) == VALID_FOLDS  # parent/child fold sets in sync
     m = int(os.environ.get("TSP_BENCH_REPS", "10"))
-    results = {}
-    if pin is not None:
-        fold, from_xy = folds[pin]
-        results[pin] = timed(pin, fold, m, from_xy=from_xy)
-    else:
-        import subprocess
-
-        for nm in folds:
-            env = dict(os.environ, TSP_BENCH_FOLD=nm, TSP_BENCH_PROBED="1")
-            try:
-                r = subprocess.run(
-                    [sys.executable, os.path.abspath(__file__)],
-                    capture_output=True, text=True, env=env, timeout=1200,
-                )
-            except subprocess.TimeoutExpired:
-                # a lapsed chip grant hangs a fresh client init forever
-                print(f"bench: fold {nm} subprocess timed out", file=sys.stderr)
-                continue
-            sys.stderr.write(r.stderr)
-            try:
-                child = json.loads(r.stdout.strip().splitlines()[-1])
-                results[nm] = (float(child["value"]), None, None)
-            except (json.JSONDecodeError, IndexError, KeyError):
-                print(f"bench: fold {nm} subprocess failed "
-                      f"(rc={r.returncode})", file=sys.stderr)
-        if not results:
-            return 1
-    for nm, (ms, v, cs) in results.items():
-        if v is not None:
-            print(
-                f"{nm}: {ms:.1f} ms/run over {m} chained runs "
-                f"(compile+first {cs:.1f}s, cost={v:.3f})",
-                file=sys.stderr,
-            )
-    best = min(results, key=lambda nm: results[nm][0])
-    value = results[best][0]
-    plan = build_plan(N)
-    nodes_per_sec = plan.dp_transitions * BLOCKS / (value / 1000.0)
-    print(f"dp_transitions/s={nodes_per_sec:.3e}", file=sys.stderr)
-
+    fold, from_xy = folds[fold_pin]
+    ms, v, cs = timed(fold_pin, fold, m, from_xy=from_xy)
     print(
-        json.dumps(
-            {
-                "metric": "pipeline_16x100_wall_ms",
-                "value": round(value, 3),
-                "unit": "ms",
-                "vs_baseline": round(BASELINE_MS / value, 2),
-                "fold": best,
-            }
-        )
+        f"{fold_pin}: {ms:.1f} ms/run over {m} chained runs "
+        f"(compile+first {cs:.1f}s, cost={v:.3f})",
+        file=sys.stderr,
     )
+    plan = build_plan(N)
+    nodes_per_sec = plan.dp_transitions * BLOCKS / (ms / 1000.0)
+    print(f"dp_transitions/s={nodes_per_sec:.3e}", file=sys.stderr)
+    print(_pipeline_json(ms, fold_pin))
+    return 0
+
+
+#: fold names accepted by TSP_BENCH_FOLD, in measurement order
+VALID_FOLDS = ("tree_xy", "tree", "scan")
+
+
+def _pipeline_json(value_ms: float, fold: str) -> str:
+    return json.dumps(
+        {
+            "metric": "pipeline_16x100_wall_ms",
+            "value": round(value_ms, 3),
+            "unit": "ms",
+            "vs_baseline": round(BASELINE_MS / value_ms, 2),
+            "fold": fold,
+        }
+    )
+
+
+def _spawn_fold_children() -> int:
+    """Measure every fold shape, each in its own subprocess, and report
+    the fastest. Process isolation matters twice on the remote relay:
+    a process's first readback permanently degrades its later dispatches
+    (so folds measured after another fold's drain would be biased), and
+    the chip claim is exclusive per process (so this parent must never
+    initialize a jax backend itself — children would deadlock)."""
+    import subprocess
+
+    results = {}
+    for nm in VALID_FOLDS:
+        env = dict(os.environ, TSP_BENCH_FOLD=nm, TSP_BENCH_PROBED="1")
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                capture_output=True, text=True, env=env, timeout=1200,
+            )
+        except subprocess.TimeoutExpired:
+            # a lapsed chip grant hangs a fresh client init forever
+            print(f"bench: fold {nm} subprocess timed out", file=sys.stderr)
+            continue
+        sys.stderr.write(r.stderr)
+        try:
+            child = json.loads(r.stdout.strip().splitlines()[-1])
+            results[nm] = float(child["value"])
+        except (json.JSONDecodeError, IndexError, KeyError):
+            print(f"bench: fold {nm} subprocess failed "
+                  f"(rc={r.returncode})", file=sys.stderr)
+    if not results:
+        return 1
+    best = min(results, key=results.get)
+    print(_pipeline_json(results[best], best))
     return 0
 
 

@@ -15,6 +15,13 @@ python bench.py 2> >(tail -8 >&2) | tee BENCH_TPU_PIPELINE.json
 echo "== B&B eil51 (north-star metric) =="
 TSP_BENCH=bnb python bench.py 2> >(tail -3 >&2) | tee BENCH_BNB_TPU.json
 
+echo "== B&B eil51 k-sweep (batch-width tuning evidence) =="
+: > BENCH_BNB_TPU_KSWEEP.jsonl
+for K in 256 4096; do
+    TSP_BENCH=bnb TSP_BENCH_K=$K python bench.py 2> >(tail -2 >&2) \
+        | tee -a BENCH_BNB_TPU_KSWEEP.jsonl
+done
+
 echo "== profiler trace =="
 python -m tsp_mpi_reduction_tpu 16 100 1000 1000 --backend=tpu \
     --dtype=float32 --trace traces/tpu_pipeline | tail -1
