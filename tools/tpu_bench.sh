@@ -22,6 +22,12 @@ for K in 256 4096; do
         | tee -a BENCH_BNB_TPU_KSWEEP.jsonl
 done
 
+echo "== kroA100 chunked (certified-gap evidence on TPU) =="
+rm -f /tmp/kroa_tpu_ck.npz
+python tools/bnb_chunked.py kroA100 --chunk-iters=20000 --max-chunks=3 \
+    --time-limit=420 --chunk-timeout=900 --checkpoint=/tmp/kroa_tpu_ck \
+    --k=1024 --capacity=$((1<<19)) | tee BENCH_KROA100_TPU.jsonl
+
 echo "== profiler trace =="
 python -m tsp_mpi_reduction_tpu 16 100 1000 1000 --backend=tpu \
     --dtype=float32 --trace traces/tpu_pipeline | tail -1
