@@ -320,6 +320,11 @@ def _spawn_fold_children() -> int:
     results = {}
     for nm in VALID_FOLDS:
         env = dict(os.environ, TSP_BENCH_FOLD=nm, TSP_BENCH_PROBED="1")
+        if env.get("JAX_PLATFORMS", "").strip() == "cpu":
+            # CPU fallback: the axon sitecustomize would re-register the
+            # remote plugin in the child and dial the dead tunnel anyway
+            # (it overrides JAX_PLATFORMS) — disarm it entirely
+            env.pop("PALLAS_AXON_POOL_IPS", None)
         try:
             r = subprocess.run(
                 [sys.executable, os.path.abspath(__file__)],
