@@ -68,6 +68,9 @@ N, BLOCKS, GRID = 16, 100, 1000
 #: f64 host ascent): 16,283 nodes/s, proof in 9.4 s; see BENCHMARKS.md.
 BNB_CPU_8RANK_ANCHOR = 8 * 16283.0
 
+#: fold names accepted by TSP_BENCH_FOLD, in measurement order
+VALID_FOLDS = ("tree_xy", "tree", "scan")
+
 
 def _accelerator_usable(timeout_s: float = 180.0) -> bool:
     """Probe accelerator init in a subprocess (it can hang on a dead tunnel).
@@ -290,10 +293,6 @@ def main() -> int:
     print(f"dp_transitions/s={nodes_per_sec:.3e}", file=sys.stderr)
     print(_pipeline_json(ms, fold_pin))
     return 0
-
-
-#: fold names accepted by TSP_BENCH_FOLD, in measurement order
-VALID_FOLDS = ("tree_xy", "tree", "scan")
 
 
 def _pipeline_json(value_ms: float, fold: str) -> str:

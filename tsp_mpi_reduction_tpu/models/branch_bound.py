@@ -1036,6 +1036,51 @@ def make_root_frontier(n: int, capacity: int, min_out: np.ndarray, dtype=jnp.flo
     )
 
 
+def _resolve_device_loop(
+    device_loop: bool, auto: bool, capacity: int, k: int, n: int,
+    what: str = "capacity", source: str = "",
+) -> bool:
+    """Enforce the device-loop compaction floor ``capacity >= 4*k*(n-1)``
+    (one push batch of headroom per step). Auto mode falls back to the
+    host loop; an explicit request raises. Re-run after restore() — the
+    checkpoint's array width overrides the caller's capacity argument."""
+    if device_loop and capacity < 4 * k * (n - 1):
+        if auto:
+            return False
+        raise ValueError(
+            f"device_loop needs {what} >= 4*k*(n-1) = {4 * k * (n - 1)} "
+            f"(got {capacity}{source}); lower k or raise capacity"
+        )
+    return device_loop
+
+
+def _acquire_cpu_polish_device(device_loop: bool):
+    """CPU device for host-pinned setup compute, or None. Must run BEFORE
+    the first jax array op (it may still widen the platform pin)."""
+    if not device_loop:
+        return None
+    from ..utils.backend import cpu_fallback_device
+
+    return cpu_fallback_device()
+
+
+def _initial_incumbent(
+    d, ils_rounds, device_loop: bool, cpu_dev
+) -> np.ndarray:
+    """The ILS incumbent for a fresh solve: a few seconds of setup that
+    routinely lands the published TSPLIB optimum, which the ceil-aware
+    pruner converts into massive savings. On the transfer-free paths the
+    polish kernels are pinned to the CPU backend (its readbacks don't
+    trip the relay's slow mode); if no CPU backend can exist, fall back
+    to the (Or-opt-less) numpy twin rather than poisoning."""
+    if device_loop and cpu_dev is None:
+        return strong_incumbent_host(d, starts=16, perturbations=ils_rounds)
+    return strong_incumbent(
+        d, starts=16, perturbations=ils_rounds,
+        device=cpu_dev if device_loop else None,
+    )
+
+
 def warm_compile_device_solver(
     n: int,
     capacity: int,
@@ -1128,23 +1173,10 @@ def solve(
     auto_device_loop = device_loop is None
     if auto_device_loop:
         device_loop = jax.default_backend() not in ("cpu",)
-    if device_loop and capacity < 4 * k * (n - 1):
-        # the in-kernel compaction headroom (min(cap/4, k*(n-1))) must
-        # cover one full push batch, or a single step could overflow-drop
-        if auto_device_loop:
-            device_loop = False  # configs valid before device_loop existed
-        else:
-            raise ValueError(
-                f"device_loop needs capacity >= 4*k*(n-1) = {4 * k * (n - 1)} "
-                f"(got {capacity}); lower k or raise capacity"
-            )
-    # must run BEFORE the first jax array op: it may still widen the
-    # platform pin to make the CPU backend available (utils.backend)
-    cpu_dev = None
-    if device_loop:
-        from ..utils.backend import cpu_fallback_device
-
-        cpu_dev = cpu_fallback_device()
+    device_loop = _resolve_device_loop(
+        device_loop, auto_device_loop, capacity, k, n
+    )
+    cpu_dev = _acquire_cpu_polish_device(device_loop)
     d32 = jnp.asarray(d, jnp.float32)
     bd = _bound_setup(d, bound, node_ascent=node_ascent, ascent=ascent)
     min_out, bound_adj, root_lb, integral = bd.min_out, bd.bound_adj, bd.root_lb, bd.integral
@@ -1159,33 +1191,12 @@ def solve(
         # argument must not disarm the spill trigger below (and the
         # device_loop guard must re-check against THIS capacity)
         capacity = int(fr.path.shape[0])
-        if device_loop and capacity < 4 * k * (n - 1):
-            if auto_device_loop:
-                device_loop = False
-            else:
-                raise ValueError(
-                    f"device_loop needs capacity >= 4*k*(n-1) = "
-                    f"{4 * k * (n - 1)}, but checkpoint {resume_from!r} was "
-                    f"written at capacity {capacity}; lower k"
-                )
+        device_loop = _resolve_device_loop(
+            device_loop, auto_device_loop, capacity, k, n,
+            source=f" from checkpoint {resume_from!r}",
+        )
     else:
-        # ILS kicks (auto for larger n): a few seconds of setup that
-        # routinely lands the published optimum as the incumbent, which the
-        # ceil-aware pruner then converts into massive savings. The
-        # device-loop path pins the polish kernels to the CPU backend: the
-        # accelerator must stay untouched until the big dispatch (see
-        # device_loop above), and CPU-client readbacks don't trip the
-        # relay's slow mode. If no CPU backend exists in this process,
-        # fall back to the (Or-opt-less) numpy twin rather than poisoning.
-        if device_loop and cpu_dev is None:
-            inc_tour_np = strong_incumbent_host(
-                d, starts=16, perturbations=ils_rounds
-            )
-        else:
-            inc_tour_np = strong_incumbent(
-                d, starts=16, perturbations=ils_rounds,
-                device=cpu_dev if device_loop else None,
-            )
+        inc_tour_np = _initial_incumbent(d, ils_rounds, device_loop, cpu_dev)
         inc_cost = jnp.asarray(
             tour_cost(np.asarray(d, np.float64), inc_tour_np), jnp.float32
         )
@@ -1345,21 +1356,11 @@ def solve_sharded(
     auto_device_loop = device_loop is None
     if auto_device_loop:
         device_loop = jax.default_backend() not in ("cpu",)
-    if device_loop and capacity_per_rank < 4 * k * (n - 1):
-        if auto_device_loop:
-            device_loop = False
-        else:
-            raise ValueError(
-                f"device_loop needs capacity_per_rank >= 4*k*(n-1) = "
-                f"{4 * k * (n - 1)} (got {capacity_per_rank}); lower k or "
-                "raise capacity"
-            )
-    # must run BEFORE the first jax array op (see solve())
-    cpu_dev = None
-    if device_loop:
-        from ..utils.backend import cpu_fallback_device
-
-        cpu_dev = cpu_fallback_device()
+    device_loop = _resolve_device_loop(
+        device_loop, auto_device_loop, capacity_per_rank, k, n,
+        what="capacity_per_rank",
+    )
+    cpu_dev = _acquire_cpu_polish_device(device_loop)
     d32 = jnp.asarray(d, jnp.float32)
     d_np = np.asarray(d, np.float64)
     bd = _bound_setup(d, bound, node_ascent=node_ascent, ascent=ascent)
@@ -1418,28 +1419,13 @@ def solve_sharded(
         # caller's argument must not disarm the spill trigger below (and
         # the device_loop floor must re-check against THIS capacity)
         capacity_per_rank = int(np.asarray(fr_h.path).shape[1])
-        if device_loop and capacity_per_rank < 4 * k * (n - 1):
-            if auto_device_loop:
-                device_loop = False
-            else:
-                raise ValueError(
-                    f"device_loop needs capacity_per_rank >= 4*k*(n-1) = "
-                    f"{4 * k * (n - 1)}, but checkpoint {resume_from!r} was "
-                    f"written at capacity {capacity_per_rank}; lower k"
-                )
+        device_loop = _resolve_device_loop(
+            device_loop, auto_device_loop, capacity_per_rank, k, n,
+            what="capacity_per_rank",
+            source=f" from checkpoint {resume_from!r}",
+        )
     else:
-        # device_loop: polish on the CPU backend — the accelerator must
-        # stay untouched before the big dispatch (relay fast-mode; CPU
-        # readbacks don't trip it; numpy-twin fallback, see solve())
-        if device_loop and cpu_dev is None:
-            inc_tour_np = strong_incumbent_host(
-                d, starts=16, perturbations=ils_rounds
-            )
-        else:
-            inc_tour_np = strong_incumbent(
-                d, starts=16, perturbations=ils_rounds,
-                device=cpu_dev if device_loop else None,
-            )
+        inc_tour_np = _initial_incumbent(d, ils_rounds, device_loop, cpu_dev)
         inc_cost0 = tour_cost(d_np, inc_tour_np)
         fr = Frontier(
             *(jax.device_put(np.stack(leaves[f]), spec) for f in Frontier._fields)
