@@ -9,9 +9,12 @@ rank-emulated merge tree (same assignment, same tree order as a p-rank MPI
 run) so the sweep runs on any machine.
 
 Usage:
-    python tools/sweep.py [--out results.csv] [--quick] [--backend=...]
+    python tools/sweep.py [--out FILE] [--quick] [--backend=...]
                           [--dtype=float64|float32]
 
+``--out`` defaults to ``results.csv``, or ``results_quick.csv`` under
+``--quick`` so smoke runs never clobber the committed full-sweep
+artifact; overwriting a ≥100-row file additionally requires ``--force``.
 ``--quick`` restricts to a small config subset (smoke-test mode). The full
 1200-config sweep compiles one XLA program per distinct shape; with the
 persistent compilation cache later sweeps are much faster.
@@ -31,7 +34,7 @@ from tsp_mpi_reduction_tpu.utils.backend import select_backend  # noqa: E402
 
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--out", default="results.csv")
+    ap.add_argument("--out", default=None)
     ap.add_argument("--grid", type=int, default=1000)  # test.sh:2
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--backend", default="auto", choices=["auto", "cpu", "tpu"])
@@ -40,7 +43,28 @@ def main() -> int:
         "--resume", action="store_true",
         help="append to --out, skipping configs it already contains",
     )
+    ap.add_argument(
+        "--force", action="store_true",
+        help="allow overwriting an existing large (non-quick) results file",
+    )
     args = ap.parse_args()
+    if args.out is None:
+        # quick smoke runs must not clobber the committed 1200-row artifact
+        # (that happened once: a --quick run overwrote results.csv and the
+        # truncation was committed unnoticed)
+        args.out = "results_quick.csv" if args.quick else "results.csv"
+    if not args.resume and not args.force:
+        try:
+            with open(args.out) as f:
+                existing = sum(1 for _ in f) - 1
+        except OSError:
+            existing = 0
+        if existing >= 100:
+            ap.error(
+                f"{args.out} holds {existing} data rows; refusing to "
+                "overwrite a full sweep artifact (use --resume, --force, "
+                "or a different --out)"
+            )
 
     platform = select_backend(args.backend)
     dtype = args.dtype or ("float64" if platform == "cpu" else "float32")
@@ -73,7 +97,17 @@ def main() -> int:
         except OSError:
             pass
 
-    mode = "a" if (args.resume and done) else "w"
+    # resume must never truncate: even if no existing row parsed (foreign
+    # schema, partial file), append rather than clobber
+    exists = False
+    if args.resume:
+        import os
+
+        try:
+            exists = os.path.getsize(args.out) > 0
+        except OSError:
+            pass
+    mode = "a" if (args.resume and exists) else "w"
     # a killed sweep can leave a partial (unterminated) last line — appending
     # straight onto it would corrupt the row; terminate it first. The partial
     # row was never counted as done (it doesn't parse as 5 fields), so its
