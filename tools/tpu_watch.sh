@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# TPU chip-grant watcher (round 4).
+#
+# The remote-TPU relay's claim/grant handshake blocks indefinitely when no
+# chip is granted to this container (round 3: grant lapsed mid-round and
+# never returned). This loop probes the claim on an interval, appends a
+# timestamped record per attempt to TPU_CLAIM_LOG.jsonl (the auditable
+# evidence trail VERDICT.md round-3 item 1 asks for if the outage
+# persists), and the moment a probe succeeds runs tools/tpu_bench.sh to
+# capture every TPU artifact in one shot.
+#
+# Usage: tools/tpu_watch.sh [interval_seconds] [probe_timeout_seconds]
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+INTERVAL="${1:-480}"
+PROBE_TIMEOUT="${2:-180}"
+LOG=TPU_CLAIM_LOG.jsonl
+
+while true; do
+    ts="$(date -u +%Y-%m-%dT%H:%M:%SZ)"
+    out="$(timeout "$PROBE_TIMEOUT" python -c \
+        'import jax; d=jax.devices(); print("PLATFORM="+d[0].platform)' 2>&1)"
+    rc=$?
+    platform="$(printf '%s' "$out" | sed -n 's/^PLATFORM=//p' | tail -1)"
+    if [ $rc -eq 0 ] && [ -n "$platform" ] && [ "$platform" != "cpu" ]; then
+        echo "{\"ts\": \"$ts\", \"ok\": true, \"platform\": \"$platform\"}" >> "$LOG"
+        echo "tpu_watch: chip granted ($platform) at $ts — capturing artifacts" >&2
+        if bash tools/tpu_bench.sh > tpu_bench_run.log 2>&1; then
+            echo "{\"ts\": \"$(date -u +%Y-%m-%dT%H:%M:%SZ)\", \"capture\": \"complete\"}" >> "$LOG"
+        else
+            echo "{\"ts\": \"$(date -u +%Y-%m-%dT%H:%M:%SZ)\", \"capture\": \"FAILED rc=$?\"}" >> "$LOG"
+        fi
+        exit 0
+    fi
+    reason="timeout after ${PROBE_TIMEOUT}s (claim/grant handshake never completed)"
+    [ $rc -ne 124 ] && reason="probe rc=$rc: $(printf '%s' "$out" | tail -c 200 | tr '"\n' ' ' )"
+    echo "{\"ts\": \"$ts\", \"ok\": false, \"reason\": \"$reason\"}" >> "$LOG"
+    sleep "$INTERVAL"
+done

@@ -223,6 +223,12 @@ def tour_cost(d: np.ndarray, tour: np.ndarray) -> float:
 #: config (200-city random + 1-tree root bound on TPU)
 MAX_BNB_CITIES = 200
 
+#: device_loop mode, when time_limit_s is set: the first dispatch's step
+#: budget before any measured steps/sec exists. ~50 s on a 1-vCPU host at
+#: eil51 rates, trivial on a TPU; subsequent dispatches scale to the
+#: measured rate so the host can re-check the clock near the limit.
+_FIRST_DISPATCH_STEPS = 5_000
+
 
 def _mask_consts(n: int):
     """Static per-``n`` helpers for the [W]-word visited bitmask.
@@ -792,6 +798,34 @@ def _expand_loop(
 NODE_FIELDS = tuple(f for f in Frontier._fields if f not in ("count", "overflow"))
 
 
+def _reorder_frontier(fr: Frontier) -> Frontier:
+    """Globally re-sort the live stack so the LOWEST-bound node sits on
+    top (popped next): one argsort + gather turns the depth-first stack
+    into best-bound-first search until dives re-bury it.
+
+    Why: the certified global lower bound is the min over open-node
+    bounds, and a DFS stack leaves the lowest-bound nodes buried for
+    most of the run — the LB only moves at the very end. Periodic
+    re-sorts (``reorder_every``) pay one [capacity]-argsort plus a
+    full-frontier gather to keep expanding the bound-critical nodes,
+    which is what raises the certified LB on gap-reporting runs
+    (kroA100, VERDICT r3 item 7). Ordering is search priority only;
+    exactness is unaffected."""
+    f_cap = fr.path.shape[0]
+    pos = jnp.arange(f_cap, dtype=jnp.int32)
+    live = pos < fr.count
+    # DESC by bound: worst live node at index 0, best at count-1 (stack
+    # top), dead entries (-inf keys) pushed past the live prefix
+    perm = jnp.argsort(-jnp.where(live, fr.bound, -INF))
+    out = {f: getattr(fr, f)[perm] for f in NODE_FIELDS}
+    return Frontier(count=fr.count, overflow=fr.overflow, **out)
+
+
+#: host-loop callers re-sort between dispatches (device_loop mode sorts
+#: inside the kernel instead)
+_reorder_frontier_jit = jax.jit(_reorder_frontier)
+
+
 def _compact_frontier(fr: Frontier, inc_cost, integral: bool) -> Frontier:
     """Drop pruned nodes from the device stack IN PLACE (stable order).
 
@@ -820,7 +854,9 @@ def _compact_frontier(fr: Frontier, inc_cost, integral: bool) -> Frontier:
 
 @partial(
     jax.jit,
-    static_argnames=("k", "n", "integral", "use_mst", "node_ascent"),
+    static_argnames=(
+        "k", "n", "integral", "use_mst", "node_ascent", "reorder_every"
+    ),
 )
 def _solve_device(
     fr: Frontier,
@@ -835,15 +871,18 @@ def _solve_device(
     ascent_step: jnp.ndarray,
     lam_budget: jnp.ndarray,
     max_steps: jnp.ndarray,
+    step0: jnp.ndarray,
     k: int,
     n: int,
     integral: bool = False,
     use_mst: bool = True,
     node_ascent: int = 0,
+    reorder_every: int = 0,
 ):
     """Run the ENTIRE search (up to ``max_steps`` expansion steps) in one
     device dispatch, with on-device stack compaction under capacity
-    pressure. Returns ``(frontier', inc_cost', inc_tour', nodes, steps)``.
+    pressure. Returns ``(frontier', inc_cost', inc_tour', nodes, steps,
+    best_step)`` — see ``_guarded_expand_steps``.
 
     This is the transfer-free fast path: on this image's remote-TPU relay
     the first device->host transfer permanently degrades every later
@@ -861,18 +900,23 @@ def _solve_device(
     return _guarded_expand_steps(
         fr, inc_cost, inc_tour, d, min_out, bound_adj, dbar, pi, mst_slack,
         ascent_step, lam_budget, max_steps, k, n, integral, use_mst,
-        node_ascent
+        node_ascent, reorder_every, step0
     )
 
 
 def _guarded_expand_steps(
     fr, inc_cost, inc_tour, d, min_out, bound_adj, dbar, pi, mst_slack,
-    ascent_step, lam_budget, max_steps, k, n, integral, use_mst, node_ascent
+    ascent_step, lam_budget, max_steps, k, n, integral, use_mst, node_ascent,
+    reorder_every: int = 0, step0=0,
 ):
     """Up to ``max_steps`` expansion steps with a PER-STEP capacity guard:
     compact under pressure, and if compaction cannot get below the
     pressure line, stop stack-intact (never an overflow-dropping push).
-    Returns ``(frontier', inc_cost', inc_tour', popped, steps_done)``.
+    Returns ``(frontier', inc_cost', inc_tour', popped, steps_done,
+    best_step)`` where ``best_step`` is the 0-based in-dispatch step index
+    of the LAST incumbent improvement (-1 if none) — the host converts it
+    to a time via the dispatch's measured rate, so ``time_to_best`` stays
+    step-accurate even when the whole search is one dispatch.
 
     Shared by ``_solve_device`` (single device; ``max_steps`` = whole
     budget) and the sharded device-resident loop (``max_steps`` =
@@ -885,11 +929,26 @@ def _guarded_expand_steps(
     headroom = min(f_cap // 4, k * (n - 1))
 
     def cond(carry):
-        fr, _, _, _, i, full = carry
+        fr, _, _, _, i, full, _ = carry
         return (i < max_steps) & (fr.count > 0) & ~fr.overflow & ~full
 
     def body(carry):
-        fr, ic, itour, nodes, i, full = carry
+        fr, ic, itour, nodes, i, full, best_step = carry
+        ic_before = ic
+        if reorder_every:
+            # periodic best-bound-first re-sort (gap-closing runs); the
+            # Python-level guard keeps the argsort+gather out of the
+            # compiled program entirely when the knob is off. step0
+            # carries the run-global step count across dispatches — a
+            # per-dispatch counter would reset each dispatch and never
+            # fire when budgets (checkpoint/clock-capped) are smaller
+            # than the period
+            fr = jax.lax.cond(
+                ((step0 + i) % reorder_every) == (reorder_every - 1),
+                _reorder_frontier,
+                lambda f: f,
+                fr,
+            )
         fr = jax.lax.cond(
             fr.count > f_cap - headroom,
             lambda f, c: _compact_frontier(f, c, integral),
@@ -918,13 +977,15 @@ def _guarded_expand_steps(
         fr, ic, itour, popped = jax.lax.cond(
             still_full, skip, do_expand, (fr, ic, itour)
         )
-        return fr, ic, itour, nodes + popped, i + 1, still_full
+        best_step = jnp.where(ic < ic_before, i, best_step)
+        return fr, ic, itour, nodes + popped, i + 1, still_full, best_step
 
     zero = fr.count * 0
-    fr, inc_cost, inc_tour, nodes, steps, _ = jax.lax.while_loop(
-        cond, body, (fr, inc_cost, inc_tour, zero, zero, fr.overflow & False)
+    fr, inc_cost, inc_tour, nodes, steps, _, best_step = jax.lax.while_loop(
+        cond, body,
+        (fr, inc_cost, inc_tour, zero, zero, fr.overflow & False, zero - 1),
     )
-    return fr, inc_cost, inc_tour, nodes, steps
+    return fr, inc_cost, inc_tour, nodes, steps, best_step
 
 
 class _Reservoir:
@@ -1088,6 +1149,7 @@ def warm_compile_device_solver(
     integral: bool = True,
     mst_prune: bool = True,
     node_ascent: int = 2,
+    reorder_every: int = 0,
 ) -> None:
     """AOT-compile ``_solve_device`` for the given static shapes WITHOUT
     executing anything on the device.
@@ -1109,8 +1171,8 @@ def warm_compile_device_solver(
     _solve_device.lower(
         fr, sd((), f32), sd((n + 1,), i32), sd((n, n), f32), sd((n,), f32),
         sd((n,), f32), sd((n, n), f32), sd((n,), f32), sd((), f32),
-        sd((), f32), sd((), f32), sd((), i32), k, n, integral, mst_prune,
-        node_ascent
+        sd((), f32), sd((), f32), sd((), i32), sd((), i32), k, n, integral,
+        mst_prune, node_ascent, reorder_every
     ).compile()
 
 
@@ -1131,8 +1193,14 @@ def solve(
     node_ascent: int = 2,
     device_loop: Optional[bool] = None,
     ascent: str = "host",
+    reorder_every: int = 0,
 ) -> BnBResult:
     """Exact B&B on one device. ``d`` is a dense [n, n] distance matrix.
+
+    ``reorder_every``: every N expansion steps, globally re-sort the
+    live stack best-bound-on-top (see _reorder_frontier) — best-bound-
+    first search for gap-closing runs; 0 (default) keeps the pure DFS
+    stack discipline.
 
     ``ascent``: where the root Held-Karp subgradient ascent runs —
     "host" (default; f64 numpy, zero device work — required by the
@@ -1156,9 +1224,13 @@ def solve(
     then runs host-side so nothing touches the device beforehand.
     Default: auto — on for accelerator backends, off for CPU (where the
     per-batch host loop costs nothing and gives finer-grained spill /
-    time-limit checks). ``time_limit_s``/``target_cost`` are only checked
-    between dispatches in this mode, and ``time_to_best`` is the readback
-    time, not the in-dispatch improvement time.
+    time-limit checks). ``time_limit_s``/``target_cost`` are checked
+    between dispatches in this mode; when ``time_limit_s`` is set, each
+    dispatch's step budget is bounded by the previous dispatch's measured
+    steps/sec (first dispatch: ``_FIRST_DISPATCH_STEPS``) so the host
+    re-checks the clock near the limit. ``time_to_best`` is step-accurate:
+    the kernel returns the in-dispatch step index of the last incumbent
+    improvement, converted to time via the dispatch's measured rate.
 
     Stops when the frontier empties (proven optimal), or at
     ``max_iters``/``time_limit_s``/``target_cost`` (then best-so-far).
@@ -1211,6 +1283,9 @@ def solve(
     nodes = 0
     it = 0
     inner = max(1, inner_steps)
+    last_ckpt = 0
+    last_reorder = 0
+    steps_rate = 0.0  # measured in-kernel steps/sec of the last dispatch
     while it < max_iters:
         if device_loop:
             # per-dispatch step cap keeps the device-side int32 node
@@ -1220,16 +1295,54 @@ def solve(
             # caps the dispatch.
             budget = min(max_iters - it, (2**31 - 1) // max(k, 1))
             if checkpoint_every and checkpoint_path:
-                budget = min(budget, max(checkpoint_every, 1))
-            fr, inc_cost, inc_tour, popped, steps = _solve_device(
+                # steps-since-last-save, not a modulo: dispatches that
+                # stop early (drained/full) would drift off a modulo grid
+                # and silently disable checkpointing
+                budget = min(
+                    budget, max(checkpoint_every - (it - last_ckpt), 1)
+                )
+            if time_limit_s is not None and jax.default_backend() == "cpu":
+                # CPU only: bound the dispatch so the host can re-check
+                # the clock near the limit (previous dispatch's measured
+                # rate; conservative cap before any rate exists). On the
+                # remote-TPU relay this splitting would be a bug, not a
+                # feature: the readback after the first dispatch flips
+                # the relay into its permanently-slow mode (~660x) and
+                # the fast-mode rate would size the next dispatch into a
+                # multi-hour overshoot — there, the search stays ONE
+                # dispatch and clock-bounded runs use the chunked driver
+                # (tools/bnb_chunked.py) with its hard per-chunk kill.
+                remaining = time_limit_s - (time.perf_counter() - t0)
+                est = (
+                    int(steps_rate * max(remaining, 0.0)) + 1
+                    if steps_rate > 0
+                    else _FIRST_DISPATCH_STEPS
+                )
+                budget = min(budget, max(est, 1))
+            t_disp = time.perf_counter()
+            fr, inc_cost, inc_tour, popped, steps, best_step = _solve_device(
                 fr, inc_cost, inc_tour, d32, min_out, bound_adj, bd.dbar,
                 bd.pi, bd.slack, bd.ascent_step, bd.lam_budget,
-                jnp.asarray(budget, jnp.int32), k, n, integral,
-                mst_prune, node_ascent
+                jnp.asarray(budget, jnp.int32), jnp.asarray(it, jnp.int32),
+                k, n, integral, mst_prune, node_ascent, reorder_every
             )
             # first readback of the run — everything before this line ran
             # in the relay's fast mode
             nodes += int(popped)
+            disp_s = time.perf_counter() - t_disp
+            if disp_s > 0 and int(steps) > 0:
+                steps_rate = int(steps) / disp_s
+            if float(inc_cost) < last_inc and int(best_step) >= 0:
+                # convert the in-dispatch improvement step into a time:
+                # dispatch start offset + the step's fraction of the
+                # dispatch's wall. Step-accurate even when the whole
+                # search is one dispatch (the generic readback-time path
+                # below could be minutes late on multi-minute dispatches).
+                last_inc = float(inc_cost)
+                t_best = (
+                    (t_disp - t0)
+                    + (int(best_step) + 1) / max(int(steps), 1) * disp_s
+                )
             it += max(int(steps), 1)
             if bool(np.asarray(fr.overflow)):
                 # exactness already lost in-kernel (unreachable unless the
@@ -1254,11 +1367,23 @@ def solve(
             cnt = int(fr.count)
         elif cnt > capacity - headroom:
             fr = reservoir.spill(fr, keep=capacity // 2)
+        if (
+            reorder_every
+            and not device_loop
+            and it - last_reorder >= reorder_every
+        ):
+            fr = _reorder_frontier_jit(fr)
+            last_reorder = it
         # checkpoint AFTER the spill/refill: a pre-spill snapshot could be
         # resumed into an immediate in-kernel overflow
-        if checkpoint_every and checkpoint_path and it % max(checkpoint_every, inner) < inner:
+        if (
+            checkpoint_every
+            and checkpoint_path
+            and it - last_ckpt >= checkpoint_every
+        ):
             save(checkpoint_path, fr, inc_cost, inc_tour, d=d, bound=bound,
                  reservoir=reservoir)
+            last_ckpt = it
         if cnt == 0:
             break
         if time_limit_s is not None and time.perf_counter() - t0 > time_limit_s:
@@ -1532,7 +1657,7 @@ def solve_sharded(
 
         def body(c):
             fr, icc, itc, nds, i, _ = c
-            fr, icc, itc, dn, _ = _guarded_expand_steps(
+            fr, icc, itc, dn, _, _ = _guarded_expand_steps(
                 fr, icc, itc, d_rep, mo_rep, ba_rep, dbar_rep, pi_rep,
                 slack_rep, step_rep, budget_rep, jnp.asarray(inner_steps),
                 k, n, integral, mst_prune, node_ascent
@@ -1650,6 +1775,8 @@ def solve_sharded(
     it = 0
     rank_nodes = np.zeros(num_ranks, np.int64)
     total0 = 1
+    last_ckpt = 0
+    rounds_rate = 0.0  # measured in-dispatch rounds/sec of the last dispatch
     while it < max_iters:
         if device_loop:
             # round budget: each in-dispatch round runs inner_steps
@@ -1661,13 +1788,32 @@ def solve_sharded(
                 (2**31 - 1) // max(k * max(inner_steps, 1) * num_ranks, 1),
             ))
             if checkpoint_every and checkpoint_path:
-                rounds = max(
-                    1, min(rounds, checkpoint_every // max(inner_steps, 1))
+                # steps-since-last-save (see the single-device loop): an
+                # early-stopping dispatch must not push later saves off a
+                # modulo grid
+                rounds = max(1, min(
+                    rounds,
+                    (checkpoint_every - (it - last_ckpt))
+                    // max(inner_steps, 1),
+                ))
+            if time_limit_s is not None and jax.default_backend() == "cpu":
+                # CPU only — see the single-device loop for why splitting
+                # dispatches by the clock must not run on the relay
+                remaining = time_limit_s - (time.perf_counter() - t0)
+                est_rounds = (
+                    int(rounds_rate * max(remaining, 0.0)) + 1
+                    if rounds_rate > 0
+                    else max(_FIRST_DISPATCH_STEPS // max(inner_steps, 1), 1)
                 )
+                rounds = max(1, min(rounds, est_rounds))
+            t_disp = time.perf_counter()
             out = step_loop(tuple(fr), ic, itour, d32, min_out, bound_adj,
                             bd.dbar, bd.pi, bd.slack, bd.ascent_step,
                             bd.lam_budget, jnp.asarray(rounds, jnp.int32))
             rounds_done = max(int(out[5][0]), 1)
+            disp_s = time.perf_counter() - t_disp
+            if disp_s > 0:
+                rounds_rate = rounds_done / disp_s
         else:
             out = step(tuple(fr), ic, itour, d32, min_out, bound_adj, bd.dbar,
                        bd.pi, bd.slack, bd.ascent_step, bd.lam_budget)
@@ -1685,10 +1831,11 @@ def solve_sharded(
         if (
             checkpoint_every
             and checkpoint_path
-            and it % max(checkpoint_every, inner_steps) < inner_steps
+            and it - last_ckpt >= checkpoint_every
         ):
             save(checkpoint_path, fr, ic, itour, d=d, bound=bound,
                  num_ranks=num_ranks, reservoir=_merge_reservoirs(reservoirs))
+            last_ckpt = it
         if int(total0) == 0:
             break
         if time_limit_s is not None and time.perf_counter() - t0 > time_limit_s:

@@ -140,10 +140,17 @@ def cpu_fallback_device():
         return jax.local_devices(backend="cpu")[0]
     except RuntimeError:
         pass
-    from jax._src import xla_bridge as xb
+    try:
+        # private API (jax._src.xla_bridge._backends): a jax upgrade may
+        # rename it — degrade to the numpy-incumbent fallback, not a crash
+        from jax._src import xla_bridge as xb
+
+        backends_inited = bool(xb._backends)
+    except (ImportError, AttributeError):
+        return None
 
     cur = jax.config.jax_platforms
-    if cur and "cpu" not in str(cur).split(",") and not xb._backends:
+    if cur and "cpu" not in str(cur).split(",") and not backends_inited:
         try:
             jax.config.update("jax_platforms", f"{cur},cpu")
             return jax.local_devices(backend="cpu")[0]
