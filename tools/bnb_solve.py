@@ -110,9 +110,6 @@ def main() -> int:
     if args.ranks > 1:
         from tsp_mpi_reduction_tpu.parallel.mesh import make_rank_mesh
 
-        if args.reorder_every:
-            print("warning: --reorder-every is single-rank only; ignored",
-                  file=sys.stderr)
         res = bb.solve_sharded(
             d,
             make_rank_mesh(args.ranks),
@@ -127,6 +124,7 @@ def main() -> int:
             checkpoint_every=args.checkpoint_every,
             resume_from=args.resume,
             device_loop={"auto": None, "on": True, "off": False}[args.device_loop],
+            reorder_every=args.reorder_every,
         )
     else:
         res = bb.solve(

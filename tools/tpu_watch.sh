@@ -26,10 +26,13 @@ while true; do
     if [ $rc -eq 0 ] && [ -n "$platform" ] && [ "$platform" != "cpu" ]; then
         echo "{\"ts\": \"$ts\", \"ok\": true, \"platform\": \"$platform\"}" >> "$LOG"
         echo "tpu_watch: chip granted ($platform) at $ts — capturing artifacts" >&2
-        if bash tools/tpu_bench.sh > tpu_bench_run.log 2>&1; then
-            echo "{\"ts\": \"$(date -u +%Y-%m-%dT%H:%M:%SZ)\", \"capture\": \"complete\"}" >> "$LOG"
+        bash tools/tpu_bench.sh > tpu_bench_run.log 2>&1
+        brc=$?
+        ts2="$(date -u +%Y-%m-%dT%H:%M:%SZ)"
+        if [ $brc -eq 0 ]; then
+            echo "{\"ts\": \"$ts2\", \"capture\": \"complete\"}" >> "$LOG"
         else
-            echo "{\"ts\": \"$(date -u +%Y-%m-%dT%H:%M:%SZ)\", \"capture\": \"FAILED rc=$?\"}" >> "$LOG"
+            echo "{\"ts\": \"$ts2\", \"capture\": \"FAILED rc=$brc\"}" >> "$LOG"
         fi
         exit 0
     fi

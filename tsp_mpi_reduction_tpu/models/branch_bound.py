@@ -1142,6 +1142,48 @@ def _initial_incumbent(
     )
 
 
+def _dispatch_budget(
+    remaining_units: int,
+    int32_cap_units: int,
+    until_ckpt_units,
+    rate_units: float,
+    remaining_s,
+    first_units: int,
+) -> int:
+    """Per-dispatch budget for device_loop mode, in dispatch units
+    (expansion steps for the single-device loop, inner_steps-rounds for
+    the sharded loop). Caps, in order:
+
+    - the remaining iteration budget;
+    - the unit count at which the device-side int32 node counter could
+      overflow within one dispatch;
+    - units until the next periodic checkpoint (steps-since-last-save,
+      NOT a modulo — early-stopping dispatches would drift off any
+      modulo grid and silently disable saving; ``None`` = no cap);
+    - CPU backends only (``remaining_s`` is None otherwise): an estimate
+      of how many units fit in the remaining clock, from the previous
+      dispatch's measured rate (``first_units`` before any rate exists)
+      so the host can re-check the limit near it. On the remote-TPU
+      relay this splitting would be a bug, not a feature: the readback
+      after the first dispatch flips the relay into its
+      permanently-slow mode (~660x) and the fast-mode rate would size
+      the next dispatch into a multi-hour overshoot — there, the search
+      stays ONE dispatch and clock-bounded runs use the chunked driver
+      (tools/bnb_chunked.py) with its hard per-chunk kill.
+    """
+    b = min(remaining_units, int32_cap_units)
+    if until_ckpt_units is not None:
+        b = min(b, until_ckpt_units)
+    if remaining_s is not None:
+        b = min(
+            b,
+            int(rate_units * max(remaining_s, 0.0)) + 1
+            if rate_units > 0
+            else first_units,
+        )
+    return max(b, 1)
+
+
 def warm_compile_device_solver(
     n: int,
     capacity: int,
@@ -1288,37 +1330,23 @@ def solve(
     steps_rate = 0.0  # measured in-kernel steps/sec of the last dispatch
     while it < max_iters:
         if device_loop:
-            # per-dispatch step cap keeps the device-side int32 node
-            # counter (up to k nodes/step) from ever overflowing; the
-            # Python accumulators below are arbitrary-precision. Periodic
-            # checkpointing requires returning to the host, so it also
-            # caps the dispatch.
-            budget = min(max_iters - it, (2**31 - 1) // max(k, 1))
-            if checkpoint_every and checkpoint_path:
-                # steps-since-last-save, not a modulo: dispatches that
-                # stop early (drained/full) would drift off a modulo grid
-                # and silently disable checkpointing
-                budget = min(
-                    budget, max(checkpoint_every - (it - last_ckpt), 1)
+            # all caps (int32 node-counter overflow, checkpoint cadence,
+            # CPU-only clock re-check) live in _dispatch_budget
+            budget = _dispatch_budget(
+                max_iters - it,
+                (2**31 - 1) // max(k, 1),
+                (checkpoint_every - (it - last_ckpt))
+                if (checkpoint_every and checkpoint_path)
+                else None,
+                steps_rate,
+                (time_limit_s - (time.perf_counter() - t0))
+                if (
+                    time_limit_s is not None
+                    and jax.default_backend() == "cpu"
                 )
-            if time_limit_s is not None and jax.default_backend() == "cpu":
-                # CPU only: bound the dispatch so the host can re-check
-                # the clock near the limit (previous dispatch's measured
-                # rate; conservative cap before any rate exists). On the
-                # remote-TPU relay this splitting would be a bug, not a
-                # feature: the readback after the first dispatch flips
-                # the relay into its permanently-slow mode (~660x) and
-                # the fast-mode rate would size the next dispatch into a
-                # multi-hour overshoot — there, the search stays ONE
-                # dispatch and clock-bounded runs use the chunked driver
-                # (tools/bnb_chunked.py) with its hard per-chunk kill.
-                remaining = time_limit_s - (time.perf_counter() - t0)
-                est = (
-                    int(steps_rate * max(remaining, 0.0)) + 1
-                    if steps_rate > 0
-                    else _FIRST_DISPATCH_STEPS
-                )
-                budget = min(budget, max(est, 1))
+                else None,
+                _FIRST_DISPATCH_STEPS,
+            )
             t_disp = time.perf_counter()
             fr, inc_cost, inc_tour, popped, steps, best_step = _solve_device(
                 fr, inc_cost, inc_tour, d32, min_out, bound_adj, bd.dbar,
@@ -1437,6 +1465,7 @@ def solve_sharded(
     node_ascent: int = 2,
     ascent: str = "host",
     device_loop: Optional[bool] = None,
+    reorder_every: int = 0,
 ) -> BnBResult:
     """Mesh-parallel B&B: per-rank frontiers, collective incumbent sharing.
 
@@ -1635,6 +1664,21 @@ def solve_sharded(
         )
     )
 
+    # per-rank best-bound-first re-sort (host-loop mode; the device loop
+    # does it in-kernel via step0 cadence): one shard-mapped
+    # argsort+gather per rank shard — see _reorder_frontier
+    reorder_ranks = jax.jit(
+        shard_map(
+            lambda fr_stacked: jax.tree.map(
+                lambda x: x[None],
+                tuple(_reorder_frontier(Frontier(*(x[0] for x in fr_stacked)))),
+            ),
+            mesh=mesh,
+            in_specs=(tuple(P(RANK_AXIS) for _ in Frontier._fields),),
+            out_specs=tuple(P(RANK_AXIS) for _ in Frontier._fields),
+        )
+    )
+
     # the device-resident outer loop (device_loop mode): MANY rounds of
     # [inner_steps guarded expansion steps -> ring balance -> incumbent
     # all_gather] run inside ONE dispatch. Each round's expansion is
@@ -1648,7 +1692,7 @@ def solve_sharded(
 
     def rank_body_loop(fr_stacked, ic_l, itour_l, d_rep, mo_rep, ba_rep,
                        dbar_rep, pi_rep, slack_rep, step_rep, budget_rep,
-                       max_rounds_rep):
+                       max_rounds_rep, it0_rep):
         local = Frontier(*(x[0] for x in fr_stacked))
 
         def cond(c):
@@ -1660,7 +1704,9 @@ def solve_sharded(
             fr, icc, itc, dn, _, _ = _guarded_expand_steps(
                 fr, icc, itc, d_rep, mo_rep, ba_rep, dbar_rep, pi_rep,
                 slack_rep, step_rep, budget_rep, jnp.asarray(inner_steps),
-                k, n, integral, mst_prune, node_ascent
+                k, n, integral, mst_prune, node_ascent,
+                reorder_every=reorder_every,
+                step0=it0_rep + i * inner_steps,
             )
             if num_ranks > 1:
                 fr = ring_balance(fr)
@@ -1708,6 +1754,7 @@ def solve_sharded(
                 P(None),
                 P(None, None),
                 P(None),
+                P(),
                 P(),
                 P(),
                 P(),
@@ -1776,40 +1823,34 @@ def solve_sharded(
     rank_nodes = np.zeros(num_ranks, np.int64)
     total0 = 1
     last_ckpt = 0
+    last_reorder = 0
     rounds_rate = 0.0  # measured in-dispatch rounds/sec of the last dispatch
     while it < max_iters:
         if device_loop:
-            # round budget: each in-dispatch round runs inner_steps
-            # expansion steps; cap so the int32 node counters (local and
-            # psum'd) cannot overflow within one dispatch, and so periodic
-            # checkpointing (which needs the host) still happens
-            rounds = max(1, min(
-                (max_iters - it) // max(inner_steps, 1),
-                (2**31 - 1) // max(k * max(inner_steps, 1) * num_ranks, 1),
-            ))
-            if checkpoint_every and checkpoint_path:
-                # steps-since-last-save (see the single-device loop): an
-                # early-stopping dispatch must not push later saves off a
-                # modulo grid
-                rounds = max(1, min(
-                    rounds,
-                    (checkpoint_every - (it - last_ckpt))
-                    // max(inner_steps, 1),
-                ))
-            if time_limit_s is not None and jax.default_backend() == "cpu":
-                # CPU only — see the single-device loop for why splitting
-                # dispatches by the clock must not run on the relay
-                remaining = time_limit_s - (time.perf_counter() - t0)
-                est_rounds = (
-                    int(rounds_rate * max(remaining, 0.0)) + 1
-                    if rounds_rate > 0
-                    else max(_FIRST_DISPATCH_STEPS // max(inner_steps, 1), 1)
+            # one in-dispatch round = inner_steps expansion steps; all
+            # caps (psum'd int32 counters, checkpoint cadence, CPU-only
+            # clock re-check) live in _dispatch_budget
+            unit = max(inner_steps, 1)
+            rounds = _dispatch_budget(
+                (max_iters - it) // unit,
+                (2**31 - 1) // max(k * unit * num_ranks, 1),
+                (checkpoint_every - (it - last_ckpt)) // unit
+                if (checkpoint_every and checkpoint_path)
+                else None,
+                rounds_rate,
+                (time_limit_s - (time.perf_counter() - t0))
+                if (
+                    time_limit_s is not None
+                    and jax.default_backend() == "cpu"
                 )
-                rounds = max(1, min(rounds, est_rounds))
+                else None,
+                max(_FIRST_DISPATCH_STEPS // unit, 1),
+            )
             t_disp = time.perf_counter()
             out = step_loop(tuple(fr), ic, itour, d32, min_out, bound_adj,
                             bd.dbar, bd.pi, bd.slack, bd.ascent_step,
-                            bd.lam_budget, jnp.asarray(rounds, jnp.int32))
+                            bd.lam_budget, jnp.asarray(rounds, jnp.int32),
+                            jnp.asarray(it, jnp.int32))
             rounds_done = max(int(out[5][0]), 1)
             disp_s = time.perf_counter() - t_disp
             if disp_s > 0:
@@ -1828,6 +1869,13 @@ def solve_sharded(
             last_inc = best
             t_best = time.perf_counter() - t0
         fr, total0 = spill_refill(fr, best)
+        if (
+            reorder_every
+            and not device_loop
+            and it - last_reorder >= reorder_every
+        ):
+            fr = Frontier(*reorder_ranks(tuple(fr)))
+            last_reorder = it
         if (
             checkpoint_every
             and checkpoint_path
