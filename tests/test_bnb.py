@@ -412,9 +412,8 @@ def test_sharded_reorder_every_exact():
     kw = dict(capacity_per_rank=1 << 12, k=16, inner_steps=4,
               bound="min-out", mst_prune=False, node_ascent=0,
               max_iters=2_000_000, reorder_every=8)
-    ref = bb.solve_sharded(d, mesh, device_loop=False, max_iters=2_000_000,
-                           capacity_per_rank=1 << 12, k=16, inner_steps=4,
-                           bound="min-out", mst_prune=False, node_ascent=0)
+    ref = bb.solve_sharded(d, mesh, device_loop=False,
+                           **{**kw, "reorder_every": 0})
     for mode in (False, True):
         res = bb.solve_sharded(d, mesh, device_loop=mode, **kw)
         assert res.proven_optimal
