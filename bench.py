@@ -125,6 +125,15 @@ def bench_bnb() -> int:
     # per-node mini-ascent depth: more steps = fewer nodes but more Prims
     # per pop; the best time-to-proof point is hardware-dependent
     na = int(os.environ.get("TSP_BENCH_NODE_ASCENT", "2"))
+    # MST bound kernel: prim (sequential chain) or boruvka (log-depth
+    # batched rounds — built for the TPU's latency profile)
+    mk = os.environ.get("TSP_BENCH_MST_KERNEL", "prim")
+    if mk not in bb._MST_CONN:
+        print(
+            f"bench: TSP_BENCH_MST_KERNEL={mk!r} is not one of "
+            f"{sorted(bb._MST_CONN)}", file=sys.stderr,
+        )
+        return 2
     on_cpu = jax.default_backend() == "cpu"
 
     t0 = time.perf_counter()
@@ -132,19 +141,19 @@ def bench_bnb() -> int:
         # no relay, no poison: a tiny warmup run compiles the host-loop
         # kernels; the fine-grained host loop also honors time_limit_s
         bb.solve(d, capacity=capacity, k=k, node_ascent=na,
-                 device_loop=False, max_iters=8)
+                 device_loop=False, max_iters=8, mst_kernel=mk)
     else:
         # AOT compile only (no device execution -> the relay stays in fast
         # mode); integral must match what _bound_setup will derive from
         # the data or the timed dispatch recompiles a new static config
         bb.warm_compile_device_solver(
-            n, capacity, k, bb._is_integral(d), True, na
+            n, capacity, k, bb._is_integral(d), True, na, mst_kernel=mk
         )
     print(f"warmup (compile): {time.perf_counter() - t0:.1f}s", file=sys.stderr)
 
     res = bb.solve(
         d, capacity=capacity, k=k, time_limit_s=600, node_ascent=na,
-        device_loop=not on_cpu, max_iters=5_000_000,
+        device_loop=not on_cpu, max_iters=5_000_000, mst_kernel=mk,
     )
     ok = res.proven_optimal and res.cost == inst.known_optimum
     print(
@@ -176,6 +185,7 @@ def bench_bnb() -> int:
                     else None
                 ),
                 "setup_s": round(res.setup_seconds, 2),
+                "mst_kernel": mk,
                 "anchor": (
                     "this engine's own 1-rank CPU rate x8 "
                     "(assumes perfect 8-way MPI scaling)"

@@ -51,6 +51,12 @@ def main() -> int:
     )
     ap.add_argument("--max-iters", type=int, default=200_000)
     ap.add_argument(
+        "--mst-kernel", default="prim", choices=["prim", "boruvka"],
+        help="MST bound kernel: prim (sequential chain, the default) or "
+        "boruvka (log-depth batched rounds built for the TPU's latency "
+        "profile); both certify the identical bound value",
+    )
+    ap.add_argument(
         "--reorder-every", type=int, default=0,
         help="every N expansion steps, re-sort the stack best-bound-first "
         "(raises the certified LB on gap-reporting runs; 0 = pure DFS)",
@@ -125,6 +131,7 @@ def main() -> int:
             resume_from=args.resume,
             device_loop={"auto": None, "on": True, "off": False}[args.device_loop],
             reorder_every=args.reorder_every,
+            mst_kernel=args.mst_kernel,
         )
     else:
         res = bb.solve(
@@ -141,6 +148,7 @@ def main() -> int:
             node_ascent=args.node_ascent,
             device_loop={"auto": None, "on": True, "off": False}[args.device_loop],
             reorder_every=args.reorder_every,
+            mst_kernel=args.mst_kernel,
         )
 
     opt = inst.known_optimum
@@ -175,6 +183,7 @@ def main() -> int:
                     else None
                 ),
                 "bound": args.bound,
+                "mst_kernel": args.mst_kernel,
                 "root_lower_bound": round(res.root_lower_bound, 3),
                 # final certified LB (min over still-open nodes; = cost when
                 # proven) — the honest gap after the search, not the root's

@@ -1,0 +1,208 @@
+"""Per-stage attribution of the B&B expansion step (VERDICT r3 item 4).
+
+Times, on the live backend, with the same transfer-free chained-dispatch
+method as bench.py (one readback per process — the remote-TPU relay
+permanently degrades dispatch latency after a process's first
+device->host transfer, so every component child gets its own process):
+
+    full_prim / full_boruvka  - _expand_loop, MST re-bound on (the real
+                                engine step, per MST kernel)
+    nomst                     - _expand_loop with use_mst=False: pop +
+                                child materialization + two-level sort +
+                                scatter push, no MST chain
+    bound_prim / bound_boruvka- _batched_mst_bound alone on a fixed
+                                popped batch (the MST chain in isolation)
+
+`full - nomst ~= bound` closes the attribution; the residual is fusion
+overlap. Warmup executions drain into the first timed window (the relay's
+block_until_ready does not block), so per-dispatch times carry a <=1/M
+overstatement — same documented bias as bench.py's timed().
+
+Usage:
+    python tools/step_profile.py [eil51] [--k=1024] [--node-ascent=2]
+Writes STEP_PROFILE.json (one object, all components).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+COMPONENTS = ("full_prim", "full_boruvka", "nomst", "bound_prim", "bound_boruvka")
+
+
+def child(args) -> int:
+    comp = os.environ["TSP_PROFILE_COMPONENT"]
+    from tsp_mpi_reduction_tpu.utils.backend import (
+        enable_persistent_cache,
+        select_backend,
+    )
+
+    platform = select_backend(args.backend)
+    enable_persistent_cache(platform)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tsp_mpi_reduction_tpu.models import branch_bound as bb
+    from tsp_mpi_reduction_tpu.utils import tsplib
+
+    inst = tsplib.embedded(args.instance)
+    d = inst.distance_matrix()
+    n = d.shape[0]
+    k = args.k
+    na = args.node_ascent
+    capacity = max(1 << 17, 8 * k * (n - 1))
+    dev = jax.devices()[0]
+
+    # host-only setup (nothing may touch the device before the chain)
+    bd = bb._bound_setup(d, "one-tree", node_ascent=na, ascent="host")
+    integral = bd.integral
+    d64 = np.asarray(d, np.float64)
+    tour = bb.nearest_neighbor_tour(d64)
+    inc_cost = jnp.asarray(bb.tour_cost(d64, tour), jnp.float32)
+    inc_tour = jnp.asarray(tour, jnp.int32)
+    fr = bb.make_root_frontier(n, capacity, np.asarray(bd.min_out, np.float64))
+    d32 = jnp.asarray(d, jnp.float32)
+
+    kern = "boruvka" if comp.endswith("boruvka") else "prim"
+    use_mst = comp != "nomst"
+
+    # warm: advance the root frontier to a realistic mid-search state
+    # (device-resident, no readback)
+    fr, inc_cost, inc_tour, _ = bb._expand_loop(
+        fr, inc_cost, inc_tour, d32, bd.min_out, bd.bound_adj, bd.dbar,
+        bd.pi, bd.slack, bd.ascent_step, bd.lam_budget, k, n,
+        args.warm_steps, integral, True, na, kern,
+    )
+
+    if comp.startswith("full") or comp == "nomst":
+        units_per_dispatch = args.steps
+
+        def dispatch(carry):
+            # carry = the previous dispatch's incumbent: a true data
+            # dependency, so the M dispatches form one chain
+            _, ic2, _, nodes = bb._expand_loop(
+                fr, carry, inc_tour, d32, bd.min_out, bd.bound_adj,
+                bd.dbar, bd.pi, bd.slack, bd.ascent_step, bd.lam_budget,
+                k, n, args.steps, integral, use_mst, na, kern,
+            )
+            return ic2
+
+    else:  # bound-only: the popped batch of the warm frontier, repeated
+        units_per_dispatch = args.bound_iters
+        lanes = jnp.arange(k, dtype=jnp.int32)
+        idx = jnp.maximum(fr.count - 1 - lanes, 0)
+        p_path = fr.path[idx]
+        p_depth = fr.depth[idx]
+        p_cost = fr.cost[idx]
+        p_mask = fr.mask[idx]
+        cur = p_path[lanes, jnp.maximum(p_depth - 1, 0)]
+        _, word_idx, bit, _ = bb._mask_consts(n)
+        unvis = (p_mask[:, word_idx] >> bit[None, :]) & 1 == 0
+
+        @jax.jit
+        def dispatch(carry):
+            def body(_, c):
+                # optimization_barrier keeps XLA from hoisting the
+                # loop-invariant bound evaluation out of the fori chain
+                pc = jax.lax.optimization_barrier(p_cost + c * 0.0)
+                val = bb._batched_mst_bound(
+                    bd.dbar, bd.pi, unvis, cur, pc, n, na,
+                    bd.ascent_step, bd.lam_budget, kern,
+                )
+                return jnp.min(jnp.where(jnp.isfinite(val), val, 1e30))
+
+            return jax.lax.fori_loop(0, args.bound_iters, body, carry)
+
+    t0 = time.perf_counter()
+    c = dispatch(inc_cost * 1.0)  # compile + first run, no readback
+    jax.block_until_ready(c)  # does not truly block on the relay (bias note)
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(args.dispatches):
+        c = dispatch(c)
+    final = float(c)  # the ONE readback: drains the chain
+    wall = time.perf_counter() - t0
+    ms_per_unit = wall * 1000.0 / (args.dispatches * units_per_dispatch)
+    print(
+        json.dumps(
+            {
+                "component": comp,
+                "ms_per_unit": round(ms_per_unit, 4),
+                "unit": "bound eval"
+                if comp.startswith("bound")
+                else "expansion step",
+                "dispatches": args.dispatches,
+                "units_per_dispatch": units_per_dispatch,
+                "compile_s": round(compile_s, 1),
+                "final_value": final,
+                "device": str(dev),
+            }
+        )
+    )
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("instance", nargs="?", default="eil51")
+    ap.add_argument("--backend", default="auto")
+    ap.add_argument("--k", type=int, default=1024)
+    ap.add_argument("--node-ascent", type=int, default=2)
+    ap.add_argument("--warm-steps", type=int, default=10)
+    ap.add_argument("--steps", type=int, default=10,
+                    help="expansion steps per timed dispatch")
+    ap.add_argument("--bound-iters", type=int, default=30,
+                    help="bound evals per timed dispatch (bound-only)")
+    ap.add_argument("--dispatches", type=int, default=12)
+    ap.add_argument("--out", default="STEP_PROFILE.json")
+    args = ap.parse_args()
+
+    if "TSP_PROFILE_COMPONENT" in os.environ:
+        return child(args)
+
+    results = {}
+    for comp in COMPONENTS:
+        env = dict(os.environ, TSP_PROFILE_COMPONENT=comp)
+        try:
+            r = subprocess.run(
+                [sys.executable] + sys.argv, capture_output=True,
+                text=True, env=env, timeout=1800,
+            )
+        except subprocess.TimeoutExpired:
+            print(f"{comp}: subprocess timed out", file=sys.stderr)
+            continue
+        sys.stderr.write(r.stderr[-2000:])
+        try:
+            results[comp] = json.loads(r.stdout.strip().splitlines()[-1])
+            print(f"{comp}: {results[comp]['ms_per_unit']} ms/"
+                  f"{results[comp]['unit']}", file=sys.stderr)
+        except (json.JSONDecodeError, IndexError):
+            print(f"{comp}: no JSON (rc={r.returncode})", file=sys.stderr)
+    if not results:
+        return 1
+    out = {
+        "instance": args.instance,
+        "k": args.k,
+        "node_ascent": args.node_ascent,
+        "method": "chained transfer-free dispatches, one readback per "
+        "component subprocess; warmup drains into the first window "
+        "(<=1/dispatches overstatement)",
+        "components": results,
+    }
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

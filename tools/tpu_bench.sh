@@ -6,29 +6,76 @@
 #                             and reports the faster (see its "fold" key)
 #   BENCH_BNB_TPU.json      - north-star B&B nodes/sec (eil51, proven)
 #   traces/tpu_pipeline/    - jax.profiler trace of the pipeline CLI
-set -euo pipefail
+#   BENCH_KROA100_TPU.jsonl - kroA100 certified-gap chunked run
+#
+# Legs are independent (no set -e): the 2026-07-30 capture showed one
+# crashed leg (kroA100) aborting the still-unrun trace leg. Legs that
+# already produced an artifact in this repo checkout are skipped, so the
+# watcher can re-invoke this script after a mid-capture grant lapse and
+# only the missing legs run.
+set -uo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== pipeline (both folds; faster one reported) =="
-python bench.py 2> >(tail -8 >&2) | tee BENCH_TPU_PIPELINE.json
+if [ ! -s BENCH_TPU_PIPELINE.json ]; then
+    echo "== pipeline (both folds; faster one reported) =="
+    python bench.py 2> >(tail -8 >&2) | tee BENCH_TPU_PIPELINE.json
+fi
 
-echo "== B&B eil51 (north-star metric) =="
-TSP_BENCH=bnb python bench.py 2> >(tail -3 >&2) | tee BENCH_BNB_TPU.json
+if [ ! -s BENCH_BNB_TPU.json ]; then
+    echo "== B&B eil51 (north-star metric) =="
+    TSP_BENCH=bnb python bench.py 2> >(tail -3 >&2) | tee BENCH_BNB_TPU.json
+fi
 
-echo "== B&B eil51 k-sweep (batch-width tuning evidence) =="
-: > BENCH_BNB_TPU_KSWEEP.jsonl
-for K in 256 4096; do
-    TSP_BENCH=bnb TSP_BENCH_K=$K python bench.py 2> >(tail -2 >&2) \
-        | tee -a BENCH_BNB_TPU_KSWEEP.jsonl
-done
+if [ "$(wc -l < BENCH_BNB_TPU_KSWEEP.jsonl 2>/dev/null || echo 0)" -lt 2 ]; then
+    # completion = both rows present; a partial file (mid-leg crash) must
+    # not block the retry, so build in a temp file and move into place
+    echo "== B&B eil51 k-sweep (batch-width tuning evidence) =="
+    : > BENCH_BNB_TPU_KSWEEP.tmp
+    for K in 256 4096; do
+        TSP_BENCH=bnb TSP_BENCH_K=$K python bench.py 2> >(tail -2 >&2) \
+            | tee -a BENCH_BNB_TPU_KSWEEP.tmp
+    done
+    [ "$(wc -l < BENCH_BNB_TPU_KSWEEP.tmp)" -ge 2 ] \
+        && mv BENCH_BNB_TPU_KSWEEP.tmp BENCH_BNB_TPU_KSWEEP.jsonl
+fi
 
-echo "== kroA100 chunked (certified-gap evidence on TPU) =="
-rm -f /tmp/kroa_tpu_ck.npz
-python tools/bnb_chunked.py kroA100 --chunk-iters=20000 --max-chunks=3 \
-    --time-limit=420 --chunk-timeout=900 --checkpoint=/tmp/kroa_tpu_ck \
-    --k=1024 --capacity=$((1<<19)) | tee BENCH_KROA100_TPU.jsonl
+if [ ! -s BENCH_BNB_TPU_BORUVKA.json ]; then
+    echo "== B&B eil51, Boruvka MST kernel (log-depth bound vs Prim) =="
+    TSP_BENCH=bnb TSP_BENCH_MST_KERNEL=boruvka python bench.py \
+        2> >(tail -3 >&2) | tee BENCH_BNB_TPU_BORUVKA.json
+    [ -s BENCH_BNB_TPU_BORUVKA.json ] || rm -f BENCH_BNB_TPU_BORUVKA.json
+fi
 
-echo "== profiler trace =="
-python -m tsp_mpi_reduction_tpu 16 100 1000 1000 --backend=tpu \
-    --dtype=float32 --trace traces/tpu_pipeline | tail -1
-echo "trace written to traces/tpu_pipeline"
+if [ ! -s STEP_PROFILE_TPU.json ]; then
+    echo "== B&B step attribution (full vs no-MST vs bound-only) =="
+    python tools/step_profile.py eil51 --k=1024 \
+        --out=STEP_PROFILE_TPU.json || true
+    [ -s STEP_PROFILE_TPU.json ] || rm -f STEP_PROFILE_TPU.json
+fi
+
+if [ ! -d traces/tpu_pipeline ]; then
+    echo "== profiler trace =="
+    rm -rf traces/tpu_pipeline.tmp
+    python -m tsp_mpi_reduction_tpu 16 100 1000 1000 --backend=tpu \
+        --dtype=float32 --trace traces/tpu_pipeline.tmp | tail -1 \
+        && mv traces/tpu_pipeline.tmp traces/tpu_pipeline \
+        && echo "trace written to traces/tpu_pipeline"
+fi
+
+if [ ! -s BENCH_KROA100_TPU.jsonl ]; then
+    echo "== kroA100 chunked (certified-gap evidence on TPU) =="
+    # SAFE dispatch sizing: a 20k-step single dispatch (~23 min of XLA
+    # execution at the measured ~70 ms/step) crashed the TPU worker on
+    # 2026-07-30; probes up to ~12 s executed fine. 300 steps ~= 21 s
+    # per dispatch; each chunk is one dispatch (fresh process, cached
+    # compile), so the run is many short executions instead of one
+    # unbounded one.
+    rm -f /tmp/kroa_tpu_ck.npz
+    python tools/bnb_chunked.py kroA100 --chunk-iters=300 --max-chunks=40 \
+        --time-limit=420 --chunk-timeout=240 --checkpoint=/tmp/kroa_tpu_ck \
+        --k=1024 --capacity=$((1<<19)) | tee BENCH_KROA100_TPU.tmp
+    # completion = the driver's final summary line made it out; a partial
+    # chunk log must not block the watcher's next retry
+    grep -q '"chunks"' BENCH_KROA100_TPU.tmp \
+        && mv BENCH_KROA100_TPU.tmp BENCH_KROA100_TPU.jsonl
+fi

@@ -428,6 +428,46 @@ def _bound_setup(
     )
 
 
+def _conn_edges(dbar, unvis, cur, n, lam=None):
+    """Connection-edge value + degree bumps -> (conn, bump).
+
+    The path relaxation closes MST(U) with one edge cur->U and one edge
+    0->U (root lanes ``cur == 0``: the two cheapest 0-incident edges).
+    Shared by the Prim and Boruvka MST kernels so the two bounds differ
+    ONLY in how the spanning-tree value is computed.
+    """
+    big = jnp.asarray(jnp.inf, dbar.dtype)
+    cities_row = jnp.arange(n, dtype=jnp.int32)[None, :]
+
+    def edge_rows(u):  # [k, n] reduced costs from each lane's vertex u
+        base = dbar[u]
+        if lam is None:
+            return base
+        return base + jnp.take_along_axis(lam, u[:, None], axis=1) + lam
+
+    def onehot(idx):
+        return cities_row == idx[:, None].astype(jnp.int32)
+
+    row_cur = jnp.where(unvis, edge_rows(cur), big)
+    row_0 = jnp.where(unvis, edge_rows(jnp.zeros_like(cur)), big)
+    a_cur = jnp.argmin(row_cur, axis=1)
+    min_cur = jnp.take_along_axis(row_cur, a_cur[:, None], axis=1)[:, 0]
+    neg2, idx2 = jax.lax.top_k(-row_0, 2)
+    is_root = cur == 0
+    conn = jnp.where(is_root, -neg2[:, 0] - neg2[:, 1], min_cur + (-neg2[:, 0]))
+    conn = jnp.where(jnp.isfinite(conn), conn, big)
+    # connection-edge degree bumps (one-hot adds: TPU lowers per-lane
+    # scatters to serialized stores, a broadcast compare is one op)
+    zero_i = jnp.zeros_like(cur)
+    bump = (
+        onehot(jnp.where(is_root, idx2[:, 1], a_cur)).astype(jnp.int32)
+        + onehot(idx2[:, 0]).astype(jnp.int32)
+        + onehot(jnp.where(is_root, zero_i, cur)).astype(jnp.int32)
+        + onehot(zero_i).astype(jnp.int32)
+    )
+    return conn, bump
+
+
 def _mst_conn(dbar, unvis, cur, n, lam=None):
     """One MST(U) + connection-edges evaluation -> (value, degrees).
 
@@ -483,23 +523,118 @@ def _mst_conn(dbar, unvis, cur, n, lam=None):
         0, n - 1, body, (intree0, mind0, closest0, deg0, zero)
     )
 
-    row_cur = jnp.where(unvis, edge_rows(cur), big)
-    row_0 = jnp.where(unvis, edge_rows(jnp.zeros_like(cur)), big)
-    a_cur = jnp.argmin(row_cur, axis=1)
-    min_cur = jnp.take_along_axis(row_cur, a_cur[:, None], axis=1)[:, 0]
-    neg2, idx2 = jax.lax.top_k(-row_0, 2)
-    is_root = cur == 0
-    conn = jnp.where(is_root, -neg2[:, 0] - neg2[:, 1], min_cur + (-neg2[:, 0]))
-    conn = jnp.where(jnp.isfinite(conn), conn, big)
-    # connection-edge degree bumps (one-hot adds, same rationale as body)
-    zero_i = jnp.zeros_like(cur)
-    bump = (
-        onehot(jnp.where(is_root, idx2[:, 1], a_cur)).astype(jnp.int32)
-        + onehot(idx2[:, 0]).astype(jnp.int32)
-        + onehot(jnp.where(is_root, zero_i, cur)).astype(jnp.int32)
-        + onehot(zero_i).astype(jnp.int32)
-    )
+    conn, bump = _conn_edges(dbar, unvis, cur, n, lam)
     return mst + conn, deg + bump
+
+
+def _mst_conn_boruvka(dbar, unvis, cur, n, lam=None):
+    """Log-depth Boruvka MST(U) + connection edges -> (value, degrees).
+
+    Same contract as ``_mst_conn`` (Prim), rebuilt for the TPU's latency
+    profile: Prim's critical path is n-1 sequential fori iterations of
+    small [k, n] ops (per-iteration overhead dominates the expansion step
+    on-chip — BENCHMARKS.md round-4 step analysis), while Boruvka runs
+    ceil(log2 n) rounds of batched [k, n, n] reductions that the VPU can
+    actually fill.
+
+    Exactness: every MST of a graph has the same total weight (all MSTs
+    share one sorted weight multiset), so the VALUE this kernel certifies
+    equals Prim's — bit-exactly under the fixed-point integral grid,
+    where sums of grid multiples are exact in f32. Ties are broken by the
+    global lexicographic order (weight, canonical edge id), which makes
+    each round's component choices cycle-free (two components that both
+    see minimum-weight edges between them necessarily choose the SAME
+    edge, which is then counted once). DEGREES may differ from Prim's
+    when ties admit multiple MSTs; any MST's degrees are an equally valid
+    subgradient for the per-node mini-ascent (the bound is certified for
+    arbitrary potentials — see _batched_mst_bound).
+
+    Rounding: the value accumulates <= n-1 real edge additions plus one
+    round-total per Boruvka round (zeros added exactly), i.e. fewer
+    error-carrying ops than the ~3n budget _bound_setup's non-integral
+    ``slack`` is sized for, so the Prim slack certifies this kernel too.
+    """
+    big = jnp.asarray(jnp.inf, dbar.dtype)
+    k = unvis.shape[0]
+    slots = jnp.arange(n, dtype=jnp.int32)[None, :]  # [1, n] root slots
+    eid_sentinel = jnp.int32(n * n)
+
+    # symmetric [k, n, n] edge weights over U x U (diagonal excluded)
+    w = jnp.broadcast_to(dbar[None, :, :], (k, n, n))
+    if lam is not None:
+        w = w + lam[:, :, None] + lam[:, None, :]
+    pair_ok = (
+        unvis[:, :, None]
+        & unvis[:, None, :]
+        & ~jnp.eye(n, dtype=bool)[None, :, :]
+    )
+    wm = jnp.where(pair_ok, w, big)
+
+    comp = jnp.broadcast_to(slots, (k, n))  # component label per vertex
+    deg = jnp.zeros((k, n), jnp.int32)
+    total = jnp.zeros((k,), dbar.dtype)
+    rounds = int(np.ceil(np.log2(max(n, 2))))  # components at least halve
+    jumps = int(np.ceil(np.log2(max(n, 2))))
+
+    for _ in range(rounds):
+        # per-vertex cheapest outgoing edge (crossing components); argmin's
+        # first-index rule picks the smallest partner u among ties, which
+        # is exactly the smallest canonical edge id for fixed v
+        cross = comp[:, :, None] != comp[:, None, :]
+        wr = jnp.where(cross, wm, big)
+        wv = jnp.min(wr, axis=2)  # [k, n]
+        pu = jnp.argmin(wr, axis=2).astype(jnp.int32)  # [k, n] partner
+        v = jnp.broadcast_to(slots, (k, n))
+        eid = jnp.where(v < pu, v * n + pu, pu * n + v)  # canonical id
+        eid = jnp.where(jnp.isfinite(wv), eid, eid_sentinel)
+
+        # per-component lex-min (weight, edge id) over member vertices;
+        # root-slot layout: component c's result lives at slot c
+        member = comp[:, None, :] == slots[:, :, None]  # [k, root, v]
+        mw = jnp.min(jnp.where(member, wv[:, None, :], big), axis=2)
+        elig = member & (wv[:, None, :] == mw[:, :, None])
+        me = jnp.min(
+            jnp.where(elig, eid[:, None, :], eid_sentinel), axis=2
+        )  # [k, root] chosen canonical edge id
+        has = jnp.isfinite(mw) & (me < eid_sentinel)
+
+        # endpoints + partner component of each root's chosen edge
+        a = jnp.where(has, me // n, 0)
+        b = jnp.where(has, me % n, 0)
+        ca = jnp.take_along_axis(comp, a, axis=1)
+        cb = jnp.take_along_axis(comp, b, axis=1)
+        partner = jnp.where(has, ca + cb - slots, slots)  # the other root
+        # count each edge once: when both endpoint components chose the
+        # same edge, the smaller root id keeps it
+        me_p = jnp.take_along_axis(me, partner, axis=1)
+        dup = has & (me_p == me) & (partner < slots)
+        add = has & ~dup
+        total = total + jnp.sum(jnp.where(add, mw, 0.0), axis=1)
+        a_oh = (slots[:, None, :] == a[:, :, None]) & add[:, :, None]
+        b_oh = (slots[:, None, :] == b[:, :, None]) & add[:, :, None]
+        deg = deg + jnp.sum(a_oh, axis=1) + jnp.sum(b_oh, axis=1)
+
+        # contract: hook each root onto its partner, break 2-cycles by
+        # letting the smaller root own the star, then pointer-jump
+        hook = jnp.where(has, partner, jnp.broadcast_to(slots, (k, n)))
+        hp = jnp.take_along_axis(hook, hook, axis=1)
+        star = jnp.where((hp == slots) & (slots < hook), slots, hook)
+        for _ in range(jumps):
+            star = jnp.take_along_axis(star, star, axis=1)
+        comp = jnp.take_along_axis(star, comp, axis=1)
+
+    conn, bump = _conn_edges(dbar, unvis, cur, n, lam)
+    # a lane whose U has 0/1 vertices has MST 0 and an infinite connection
+    # value — same shape Prim produces; callers turn non-finite into big
+    return total + conn, deg + bump
+
+
+#: expansion-time MST kernels (static ``mst_kernel`` selects one): "prim"
+#: is the [k, n] fori-loop chain (the default everywhere), "boruvka" the
+#: log-depth batched variant built for the TPU's latency profile — select
+#: it explicitly (--mst-kernel / TSP_BENCH_MST_KERNEL); it is NOT chosen
+#: automatically on any backend (and is ~10x slower on a scalar CPU)
+_MST_CONN = {"prim": _mst_conn, "boruvka": _mst_conn_boruvka}
 
 
 def _batched_mst_bound(
@@ -512,6 +647,7 @@ def _batched_mst_bound(
     node_ascent: int = 0,
     ascent_step=None,
     lam_budget=None,
+    mst_kernel: str = "prim",
 ):
     """Reduced-cost MST + connection-edges lower bound for a batch of nodes.
 
@@ -545,8 +681,9 @@ def _batched_mst_bound(
     """
     k = unvis.shape[0]
     big = jnp.asarray(jnp.inf, dbar.dtype)
+    mst_conn = _MST_CONN[mst_kernel]
 
-    val, deg = _mst_conn(dbar, unvis, cur, n)
+    val, deg = mst_conn(dbar, unvis, cur, n)
     val = jnp.where(jnp.isfinite(val), val, big)
     sum_pi_u = jnp.sum(jnp.where(unvis, pi[None, :], 0.0), axis=1)
     best = p_cost + val - pi[cur] - pi[0] - 2.0 * sum_pi_u
@@ -568,7 +705,7 @@ def _batched_mst_bound(
             # budgeted in _bound_setup (any clamped lam is still a valid
             # potential, so the bound stays certified)
             lam = jnp.clip(lam + step * g, -budget, budget)
-            val, deg = _mst_conn(dbar, unvis, cur, n, lam)
+            val, deg = mst_conn(dbar, unvis, cur, n, lam)
             val = jnp.where(jnp.isfinite(val), val, big)
             lam_cur = jnp.take_along_axis(lam, cur[:, None].astype(jnp.int32), axis=1)[:, 0]
             corr = (
@@ -580,7 +717,10 @@ def _batched_mst_bound(
 
 
 @partial(
-    jax.jit, static_argnames=("k", "n", "integral", "use_mst", "node_ascent")
+    jax.jit,
+    static_argnames=(
+        "k", "n", "integral", "use_mst", "node_ascent", "mst_kernel"
+    ),
 )
 def _expand_step(
     fr: Frontier,
@@ -599,6 +739,7 @@ def _expand_step(
     integral: bool = False,
     use_mst: bool = True,
     node_ascent: int = 0,
+    mst_kernel: str = "prim",
 ):
     """Pop <=K nodes, expand, prune, push. Returns (frontier', inc', stats).
 
@@ -645,7 +786,7 @@ def _expand_step(
         strong = (
             _batched_mst_bound(
                 dbar, pi, unvis, cur, p_cost, n, node_ascent, ascent_step,
-                lam_budget
+                lam_budget, mst_kernel
             )
             - mst_slack
         )
@@ -744,7 +885,10 @@ def _expand_step(
 
 @partial(
     jax.jit,
-    static_argnames=("k", "n", "inner_steps", "integral", "use_mst", "node_ascent"),
+    static_argnames=(
+        "k", "n", "inner_steps", "integral", "use_mst", "node_ascent",
+        "mst_kernel",
+    ),
 )
 def _expand_loop(
     fr: Frontier,
@@ -764,6 +908,7 @@ def _expand_loop(
     integral: bool = False,
     use_mst: bool = True,
     node_ascent: int = 0,
+    mst_kernel: str = "prim",
 ):
     """Run up to ``inner_steps`` expansion steps in ONE device program.
 
@@ -779,7 +924,8 @@ def _expand_loop(
         fr, ic, itour, nodes, i = carry
         fr, ic, itour, stats = _expand_step(
             fr, ic, itour, d, min_out, bound_adj, dbar, pi, mst_slack,
-            ascent_step, lam_budget, k, n, integral, use_mst, node_ascent
+            ascent_step, lam_budget, k, n, integral, use_mst, node_ascent,
+            mst_kernel
         )
         return fr, ic, itour, nodes + stats["popped"], i + 1
 
@@ -855,7 +1001,8 @@ def _compact_frontier(fr: Frontier, inc_cost, integral: bool) -> Frontier:
 @partial(
     jax.jit,
     static_argnames=(
-        "k", "n", "integral", "use_mst", "node_ascent", "reorder_every"
+        "k", "n", "integral", "use_mst", "node_ascent", "reorder_every",
+        "mst_kernel",
     ),
 )
 def _solve_device(
@@ -878,6 +1025,7 @@ def _solve_device(
     use_mst: bool = True,
     node_ascent: int = 0,
     reorder_every: int = 0,
+    mst_kernel: str = "prim",
 ):
     """Run the ENTIRE search (up to ``max_steps`` expansion steps) in one
     device dispatch, with on-device stack compaction under capacity
@@ -900,14 +1048,14 @@ def _solve_device(
     return _guarded_expand_steps(
         fr, inc_cost, inc_tour, d, min_out, bound_adj, dbar, pi, mst_slack,
         ascent_step, lam_budget, max_steps, k, n, integral, use_mst,
-        node_ascent, reorder_every, step0
+        node_ascent, reorder_every, step0, mst_kernel
     )
 
 
 def _guarded_expand_steps(
     fr, inc_cost, inc_tour, d, min_out, bound_adj, dbar, pi, mst_slack,
     ascent_step, lam_budget, max_steps, k, n, integral, use_mst, node_ascent,
-    reorder_every: int = 0, step0=0,
+    reorder_every: int = 0, step0=0, mst_kernel: str = "prim",
 ):
     """Up to ``max_steps`` expansion steps with a PER-STEP capacity guard:
     compact under pressure, and if compaction cannot get below the
@@ -966,7 +1114,7 @@ def _guarded_expand_steps(
             fr, ic, itour, stats = _expand_step(
                 fr, ic, itour, d, min_out, bound_adj, dbar, pi, mst_slack,
                 ascent_step, lam_budget, k, n, integral, use_mst,
-                node_ascent
+                node_ascent, mst_kernel
             )
             return fr, ic, itour, stats["popped"]
 
@@ -1192,6 +1340,7 @@ def warm_compile_device_solver(
     mst_prune: bool = True,
     node_ascent: int = 2,
     reorder_every: int = 0,
+    mst_kernel: str = "prim",
 ) -> None:
     """AOT-compile ``_solve_device`` for the given static shapes WITHOUT
     executing anything on the device.
@@ -1214,7 +1363,7 @@ def warm_compile_device_solver(
         fr, sd((), f32), sd((n + 1,), i32), sd((n, n), f32), sd((n,), f32),
         sd((n,), f32), sd((n, n), f32), sd((n,), f32), sd((), f32),
         sd((), f32), sd((), f32), sd((), i32), sd((), i32), k, n, integral,
-        mst_prune, node_ascent, reorder_every
+        mst_prune, node_ascent, reorder_every, mst_kernel
     ).compile()
 
 
@@ -1236,8 +1385,15 @@ def solve(
     device_loop: Optional[bool] = None,
     ascent: str = "host",
     reorder_every: int = 0,
+    mst_kernel: str = "prim",
 ) -> BnBResult:
     """Exact B&B on one device. ``d`` is a dense [n, n] distance matrix.
+
+    ``mst_kernel``: "prim" (sequential [k, n] chain — the default on
+    every backend) or "boruvka" (log-depth batched variant built for the
+    TPU's latency profile; opt in explicitly); both certify the identical
+    MST value, so node counts can differ only through ascent-degree tie
+    effects (see _mst_conn_boruvka).
 
     ``reorder_every``: every N expansion steps, globally re-sort the
     live stack best-bound-on-top (see _reorder_frontier) — best-bound-
@@ -1352,7 +1508,8 @@ def solve(
                 fr, inc_cost, inc_tour, d32, min_out, bound_adj, bd.dbar,
                 bd.pi, bd.slack, bd.ascent_step, bd.lam_budget,
                 jnp.asarray(budget, jnp.int32), jnp.asarray(it, jnp.int32),
-                k, n, integral, mst_prune, node_ascent, reorder_every
+                k, n, integral, mst_prune, node_ascent, reorder_every,
+                mst_kernel
             )
             # first readback of the run — everything before this line ran
             # in the relay's fast mode
@@ -1381,7 +1538,7 @@ def solve(
             fr, inc_cost, inc_tour, popped = _expand_loop(
                 fr, inc_cost, inc_tour, d32, min_out, bound_adj, bd.dbar,
                 bd.pi, bd.slack, bd.ascent_step, bd.lam_budget, k, n, inner,
-                integral, mst_prune, node_ascent
+                integral, mst_prune, node_ascent, mst_kernel
             )
             nodes += int(popped)
             it += inner
@@ -1466,6 +1623,7 @@ def solve_sharded(
     ascent: str = "host",
     device_loop: Optional[bool] = None,
     reorder_every: int = 0,
+    mst_kernel: str = "prim",
 ) -> BnBResult:
     """Mesh-parallel B&B: per-rank frontiers, collective incumbent sharing.
 
@@ -1620,7 +1778,7 @@ def solve_sharded(
         f2, c2, t2, nodes = _expand_loop(
             local, ic_l[0], itour_l[0], d_rep, mo_rep, ba_rep, dbar_rep,
             pi_rep, slack_rep, step_rep, budget_rep, k, n, inner_steps,
-            integral, mst_prune, node_ascent
+            integral, mst_prune, node_ascent, mst_kernel
         )
         if num_ranks > 1:
             f2 = ring_balance(f2)
@@ -1707,6 +1865,7 @@ def solve_sharded(
                 k, n, integral, mst_prune, node_ascent,
                 reorder_every=reorder_every,
                 step0=it0_rep + i * inner_steps,
+                mst_kernel=mst_kernel,
             )
             if num_ranks > 1:
                 fr = ring_balance(fr)
