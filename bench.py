@@ -68,8 +68,17 @@ N, BLOCKS, GRID = 16, 100, 1000
 #: f64 host ascent): 16,283 nodes/s, proof in 9.4 s; see BENCHMARKS.md.
 BNB_CPU_8RANK_ANCHOR = 8 * 16283.0
 
-#: fold names accepted by TSP_BENCH_FOLD, in measurement order
-VALID_FOLDS = ("tree_xy", "tree", "scan")
+#: fold names accepted by TSP_BENCH_FOLD, in measurement order.
+#: tree_xy_polish = the fastest fold + an on-device best-improvement
+#: 2-opt polish of the final tour — the non-associative fold order makes
+#: tree tours ~10% costlier than scan tours (BENCH_TPU_PIPELINE r4), and
+#: a polish pass converts that gap into a measured-length win the
+#: reference pipeline cannot reach at any fold order
+VALID_FOLDS = ("tree_xy", "tree", "scan", "tree_xy_polish")
+
+#: best-improvement 2-opt cap for the polish fold (one reversal per
+#: iteration; the while_loop exits at convergence)
+POLISH_MAX_ITERS = 512
 
 
 def _accelerator_usable(timeout_s: float = 180.0) -> bool:
@@ -242,6 +251,10 @@ def main() -> int:
     from tsp_mpi_reduction_tpu.ops.distance import distance_matrix
     from tsp_mpi_reduction_tpu.ops.generator import generate_instance
     from tsp_mpi_reduction_tpu.ops.held_karp import build_plan, solve_blocks_from_dists
+    from tsp_mpi_reduction_tpu.ops.local_search import (
+        tour_length,
+        two_opt_sweep,
+    )
     from tsp_mpi_reduction_tpu.ops.merge import (
         fold_tours,
         fold_tours_tree,
@@ -259,7 +272,9 @@ def main() -> int:
     _, xy = generate_instance(N, BLOCKS, GRID, GRID)
     xy32 = jnp.asarray(np.asarray(xy, np.float32))
 
-    def make_step(fold, from_xy):
+    def make_step(fold, from_xy, polish):
+        total = N * BLOCKS
+
         @jax.jit
         def step(xy_blocks, feedback):
             flat = xy_blocks.reshape(-1, 2)
@@ -270,16 +285,26 @@ def main() -> int:
             ids, length, cost = fold(
                 local_tours.astype(jnp.int32) + offsets, costs, ctx
             )
+            # measured true length alongside the reference-semantics
+            # formulaic cost (quirk #4: the splice is never re-measured)
+            dist = ctx if not from_xy else distance_matrix(flat)
+            t_open = ids[:total]  # drop the closing duplicate
+            if polish:
+                t_open, _ = two_opt_sweep(
+                    t_open, dist, closed=True, max_iters=POLISH_MAX_ITERS
+                )
+            measured = tour_length(t_open, dist)
+            head = measured if polish else cost
             # feedback*0 threads the previous run's output into this run's
             # input: the M timed runs form one dependency chain, so a
             # single final readback drains them all (see module docstring)
-            return cost + feedback * 0.0
+            return head + feedback * 0.0, cost, measured
         return step
 
-    def timed(name, fold, m, from_xy=False):
-        step = make_step(fold, from_xy)
+    def timed(name, fold, m, from_xy=False, polish=False):
+        step = make_step(fold, from_xy, polish)
         t0 = time.perf_counter()
-        c = step(xy32, jnp.float32(0.0))  # compile+first run; no readback
+        c, _, _ = step(xy32, jnp.float32(0.0))  # compile+first run; no readback
         # block_until_ready does NOT block in the relay's fast mode, and
         # any true sync is a device->host transfer that would poison every
         # subsequent dispatch — so the warmup run's execution tail can
@@ -290,10 +315,10 @@ def main() -> int:
         compile_s = time.perf_counter() - t0
         t0 = time.perf_counter()
         for _ in range(m):
-            c = step(xy32, c)
+            c, cost, measured = step(xy32, c)
         v = float(c)  # ONE readback: drains the chained queue
         per_run = (time.perf_counter() - t0) * 1000.0 / m
-        return per_run, v, compile_s
+        return per_run, v, compile_s, float(cost), float(measured)
 
     # CHILD: measure the one fold this process is pinned to (see
     # _spawn_fold_children for why folds are process-isolated): the tree
@@ -306,36 +331,41 @@ def main() -> int:
     # tree and scan costs legitimately differ — exactly as the
     # reference's output differs across rank counts.
     folds = {
-        "tree_xy": (fold_tours_tree_xy, True),
-        "tree": (fold_tours_tree, False),
-        "scan": (fold_tours, False),
+        "tree_xy": (fold_tours_tree_xy, True, False),
+        "tree": (fold_tours_tree, False, False),
+        "scan": (fold_tours, False, False),
+        "tree_xy_polish": (fold_tours_tree_xy, True, True),
     }
     assert tuple(folds) == VALID_FOLDS  # parent/child fold sets in sync
     m = int(os.environ.get("TSP_BENCH_REPS", "20"))  # bias <= 1/m, see timed()
-    fold, from_xy = folds[fold_pin]
-    ms, v, cs = timed(fold_pin, fold, m, from_xy=from_xy)
+    fold, from_xy, polish = folds[fold_pin]
+    ms, v, cs, cost, measured = timed(
+        fold_pin, fold, m, from_xy=from_xy, polish=polish
+    )
     print(
         f"{fold_pin}: {ms:.1f} ms/run over {m} chained runs "
-        f"(compile+first {cs:.1f}s, cost={v:.3f})",
+        f"(compile+first {cs:.1f}s, cost={cost:.3f}, measured={measured:.3f})",
         file=sys.stderr,
     )
     plan = build_plan(N)
     nodes_per_sec = plan.dp_transitions * BLOCKS / (ms / 1000.0)
     print(f"dp_transitions/s={nodes_per_sec:.3e}", file=sys.stderr)
-    print(_pipeline_json(ms, fold_pin, cost=v))
+    print(_pipeline_json(ms, fold_pin, cost=v, measured=measured))
     return 0
 
 
 def _pipeline_json(
     value_ms: float, fold: str, cost: float | None = None,
-    folds: dict | None = None,
+    folds: dict | None = None, measured: float | None = None,
 ) -> str:
-    """One-line artifact. ``cost`` is the reported fold's tour cost (the
-    merge operator is non-associative, so folds trade speed against tour
-    quality — the artifact must show both); ``folds`` carries every
-    measured fold's {ms, cost} so the trade-off is in the JSON itself,
-    not just stderr. Baseline cost for this instance: 34367.05 (the
-    reference's own single-rank fold order, BASELINE.md 16x100 row)."""
+    """One-line artifact. ``cost`` is the reported fold's headline cost
+    (formulaic reference semantics for plain folds — quirk #4 — but the
+    MEASURED length for the polish fold, whose point is true quality);
+    ``measured`` is always the re-measured length of the final tour;
+    ``folds`` carries every measured fold's {ms, cost, measured} so the
+    speed/quality trade-off is in the JSON itself, not just stderr.
+    Baseline cost for this instance: 34367.05 (the reference's own
+    single-rank fold order, BASELINE.md 16x100 row)."""
     out = {
         "metric": "pipeline_16x100_wall_ms",
         "value": round(value_ms, 3),
@@ -346,6 +376,8 @@ def _pipeline_json(
     if cost is not None:
         out["cost"] = round(cost, 3)
         out["baseline_cost"] = 34367.048
+    if measured is not None:
+        out["measured"] = round(measured, 3)
     if folds is not None:
         out["folds"] = folds
     return json.dumps(out)
@@ -383,6 +415,7 @@ def _spawn_fold_children() -> int:
             results[nm] = {
                 "ms": float(child["value"]),
                 "cost": child.get("cost"),
+                "measured": child.get("measured"),
             }
         except (json.JSONDecodeError, IndexError, KeyError):
             print(f"bench: fold {nm} subprocess failed "
@@ -391,7 +424,8 @@ def _spawn_fold_children() -> int:
         return 1
     best = min(results, key=lambda nm: results[nm]["ms"])
     print(_pipeline_json(
-        results[best]["ms"], best, cost=results[best]["cost"], folds=results
+        results[best]["ms"], best, cost=results[best]["cost"],
+        folds=results, measured=results[best].get("measured"),
     ))
     return 0
 

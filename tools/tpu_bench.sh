@@ -39,6 +39,13 @@ if [ "$(wc -l < BENCH_BNB_TPU_KSWEEP.jsonl 2>/dev/null || echo 0)" -lt 2 ]; then
         && mv BENCH_BNB_TPU_KSWEEP.tmp BENCH_BNB_TPU_KSWEEP.jsonl
 fi
 
+if [ ! -s BENCH_TPU_POLISH.json ]; then
+    echo "== pipeline polish fold (measured-length quality headline) =="
+    TSP_BENCH_FOLD=tree_xy_polish python bench.py \
+        2> >(tail -3 >&2) | tee BENCH_TPU_POLISH.json
+    [ -s BENCH_TPU_POLISH.json ] || rm -f BENCH_TPU_POLISH.json
+fi
+
 if [ ! -s BENCH_BNB_TPU_BORUVKA.json ]; then
     echo "== B&B eil51, Boruvka MST kernel (log-depth bound vs Prim) =="
     TSP_BENCH=bnb TSP_BENCH_MST_KERNEL=boruvka python bench.py \
