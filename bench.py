@@ -170,7 +170,9 @@ def bench_bnb() -> int:
     print(
         f"{name}: cost={res.cost} (known {inst.known_optimum}) "
         f"proven={res.proven_optimal} nodes={res.nodes_expanded} "
-        f"wall={res.wall_seconds:.2f}s setup={res.setup_seconds:.1f}s",
+        f"wall={res.wall_seconds:.2f}s setup={res.setup_seconds:.1f}s "
+        f"(ascent {res.ascent_seconds:.1f} + ils {res.ils_seconds:.1f} + "
+        f"backend {res.setup_seconds - res.ascent_seconds - res.ils_seconds:.1f})",
         file=sys.stderr,
     )
     if not ok:
@@ -196,6 +198,8 @@ def bench_bnb() -> int:
                     else None
                 ),
                 "setup_s": round(res.setup_seconds, 2),
+                "setup_ascent_s": round(res.ascent_seconds, 2),
+                "setup_ils_s": round(res.ils_seconds, 2),
                 "mst_kernel": mk,
                 "anchor": (
                     "this engine's own 1-rank CPU rate x8 "

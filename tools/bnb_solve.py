@@ -166,6 +166,8 @@ def main() -> int:
                 "time_to_best_s": round(res.time_to_best, 4),
                 "wall_s": round(res.wall_seconds, 3),
                 "setup_s": round(res.setup_seconds, 3),
+                "setup_ascent_s": round(res.ascent_seconds, 3),
+                "setup_ils_s": round(res.ils_seconds, 3),
                 # end-to-end time-to-optimal: bound construction + ILS
                 # incumbent setup + search (root-closure instances do ~all
                 # their work in setup, so wall alone would flatter them)

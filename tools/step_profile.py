@@ -36,6 +36,17 @@ sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
 COMPONENTS = ("full_prim", "full_boruvka", "nomst", "bound_prim", "bound_boruvka")
 
+#: finer-grained slices of the nomst step (--fine): each adds one stage
+#: on top of the previous, so successive differences attribute the step:
+#:   popgather       - pop gathers + unvis + child cost/bound/mask/path
+#:                     materialization (no sort, no scatter)
+#:   sort            - popgather + the two-level priority argsorts + the
+#:                     flattened push order
+#:   scatter_noorder - popgather + compaction cumsum + the six scatter
+#:                     pushes of UNORDERED children (no [order] gather)
+#:   scatter         - the full nomst step body (== nomst, cross-check)
+FINE_COMPONENTS = ("popgather", "sort", "scatter_noorder", "scatter")
+
 
 def child(args) -> int:
     comp = os.environ["TSP_PROFILE_COMPONENT"]
@@ -73,7 +84,7 @@ def child(args) -> int:
     d32 = jnp.asarray(d, jnp.float32)
 
     kern = "boruvka" if comp.endswith("boruvka") else "prim"
-    use_mst = comp != "nomst"
+    use_mst = comp not in ("nomst",) + FINE_COMPONENTS
 
     # warm: advance the root frontier to a realistic mid-search state
     # (device-resident, no readback)
@@ -83,7 +94,133 @@ def child(args) -> int:
         args.warm_steps, integral, True, na, kern,
     )
 
-    if comp.startswith("full") or comp == "nomst":
+    if comp in FINE_COMPONENTS:
+        # staged replica of the nomst step body. The frontier rides the
+        # fori_loop CARRY (as in the real _expand_loop) so XLA gets the
+        # same in-place-scatter aliasing opportunity — a loop-invariant
+        # frontier would force a copy-on-write of every buffer per
+        # iteration and overstate the scatter stages. popgather/sort
+        # return the frontier unchanged (they re-pop the same warm state
+        # each iteration); the scatter stages evolve it like the real
+        # nomst step ('scatter' IS nomst re-derived — its number
+        # cross-checks the coarse component). Stage outputs feed the
+        # incumbent carry via a min() no-op (values ~1e6-scale, the
+        # incumbent ~1e2), so XLA can neither hoist nor dead-code the
+        # stage under test. popgather's child arrays are consumed by
+        # cheap full reduces, which XLA may fuse without materializing
+        # to HBM — read its number as a LOWER bound for that stage.
+        # The replica must be kept in sync with _expand_step by hand;
+        # it omits the incumbent-TOUR update, the stats reductions and
+        # the while_loop's count>0 guard, so 'scatter' undershoots the
+        # coarse 'nomst' by those (small, fixed) costs — a known
+        # methodological offset in the cross-check, not noise.
+        units_per_dispatch = args.steps
+        f_cap = fr.path.shape[0]
+        lanes = jnp.arange(k, dtype=jnp.int32)
+        cities = jnp.arange(n, dtype=jnp.int32)
+        _, word_idx, bit, set_bit = bb._mask_consts(n)
+        integral_f = bool(integral)
+
+        def stage_once(f, c):
+            take = jnp.minimum(f.count, k)
+            idx = jnp.maximum(f.count - 1 - lanes, 0)
+            live = lanes < take
+            if integral_f:
+                live = live & (f.bound[idx] <= c - 1.0)
+            else:
+                live = live & (f.bound[idx] < c)
+            p_path = f.path[idx]
+            p_mask = f.mask[idx]
+            p_depth = f.depth[idx]
+            p_cost = f.cost[idx] + c * 0.0  # carry dependency
+            p_sum = f.sum_min[idx]
+            cur = p_path[lanes, jnp.maximum(p_depth - 1, 0)]
+            unvis = (p_mask[:, word_idx] >> bit[None, :]) & 1 == 0
+            feasible = unvis & live[:, None]
+            ccost = p_cost[:, None] + d32[cur]
+            cbound = ccost + p_sum[:, None] + bd.bound_adj[None, :]
+            cdepth = p_depth[:, None] + 1
+            is_complete = (cdepth == n) & feasible
+            total = ccost + d32[cities, 0][None, :]
+            comp_total = jnp.where(is_complete, total, bb.INF)
+            new_inc = jnp.minimum(c, jnp.min(comp_total))
+            if integral_f:
+                push = feasible & ~is_complete & (cbound <= new_inc - 1.0)
+            else:
+                push = feasible & ~is_complete & (cbound < new_inc)
+            child_mask = p_mask[:, None, :] | set_bit[None, :, :]
+            child_sum = p_sum[:, None] - bd.min_out[None, :]
+            child_path = jnp.broadcast_to(p_path[:, None, :], (k, n, n))
+            child_path = jnp.where(
+                (jnp.arange(n)[None, None, :]
+                 == jnp.minimum(p_depth[:, None, None], n - 1)),
+                cities[None, :, None],
+                child_path,
+            )
+            if comp == "popgather":
+                s = (
+                    jnp.sum(jnp.where(push, cbound, 0.0))
+                    + jnp.sum(child_path).astype(jnp.float32)
+                    + jnp.sum(child_mask).astype(jnp.float32)
+                    + jnp.sum(child_sum)
+                )
+                return f, jnp.minimum(new_inc, jnp.abs(s) + 1e6)
+            if comp == "scatter_noorder":
+                flat_push_o = push.reshape(-1)
+                vals_path = child_path.reshape(-1, n)
+                vals_mask = child_mask.reshape(-1, child_mask.shape[-1])
+                vals_depth = jnp.broadcast_to(cdepth, (k, n)).reshape(-1)
+                vals_cost = ccost.reshape(-1)
+                vals_bound = cbound.reshape(-1)
+                vals_sum = child_sum.reshape(-1)
+            else:  # sort / scatter: the two-level priority order
+                keys = jnp.where(push, cbound, -bb.INF)
+                child_ord = jnp.argsort(-keys, axis=1)
+                best_child = jnp.min(jnp.where(push, cbound, bb.INF), axis=1)
+                parent_key = jnp.where(
+                    jnp.isfinite(best_child), best_child, -bb.INF
+                )
+                parent_ord = jnp.argsort(-parent_key)
+                order = (
+                    parent_ord[:, None] * n + child_ord[parent_ord]
+                ).reshape(-1)
+                if comp == "sort":
+                    s = (order[0] + order[-1]).astype(jnp.float32)
+                    return f, jnp.minimum(new_inc, jnp.abs(s) + 1e6)
+                flat_push_o = push.reshape(-1)[order]
+                vals_path = child_path.reshape(-1, n)[order]
+                vals_mask = child_mask.reshape(-1, child_mask.shape[-1])[order]
+                vals_depth = jnp.broadcast_to(cdepth, (k, n)).reshape(-1)[order]
+                vals_cost = ccost.reshape(-1)[order]
+                vals_bound = cbound.reshape(-1)[order]
+                vals_sum = child_sum.reshape(-1)[order]
+            base = f.count - take
+            dest = base + jnp.cumsum(flat_push_o.astype(jnp.int32)) - 1
+            dest = jnp.where(flat_push_o, dest, f_cap)
+            dest = jnp.minimum(dest, f_cap)
+            n_push = flat_push_o.sum()
+            new_path = f.path.at[dest].set(vals_path, mode="drop")
+            new_mask = f.mask.at[dest].set(vals_mask, mode="drop")
+            new_depth = f.depth.at[dest].set(vals_depth, mode="drop")
+            new_cost = f.cost.at[dest].set(vals_cost, mode="drop")
+            new_bound = f.bound.at[dest].set(vals_bound, mode="drop")
+            new_sum = f.sum_min.at[dest].set(vals_sum, mode="drop")
+            new_count = jnp.minimum(base + n_push.astype(jnp.int32), f_cap)
+            overflow = f.overflow | (base + n_push > f_cap)
+            nf = bb.Frontier(
+                new_path, new_mask, new_depth, new_cost, new_bound,
+                new_sum, new_count, overflow,
+            )
+            return nf, new_inc
+
+        @jax.jit
+        def dispatch(carry):
+            _, c = jax.lax.fori_loop(
+                0, args.steps, lambda _, fc: stage_once(*fc), (fr, carry)
+            )
+            return c
+
+    elif comp.startswith("full") or comp == "nomst":
         units_per_dispatch = args.steps
 
         def dispatch(carry):
@@ -164,13 +301,17 @@ def main() -> int:
                     help="bound evals per timed dispatch (bound-only)")
     ap.add_argument("--dispatches", type=int, default=12)
     ap.add_argument("--out", default="STEP_PROFILE.json")
+    ap.add_argument("--fine", action="store_true",
+                    help="profile the staged slices of the nomst step "
+                    "(popgather/sort/scatter) instead of the coarse "
+                    "components")
     args = ap.parse_args()
 
     if "TSP_PROFILE_COMPONENT" in os.environ:
         return child(args)
 
     results = {}
-    for comp in COMPONENTS:
+    for comp in (FINE_COMPONENTS if args.fine else COMPONENTS):
         env = dict(os.environ, TSP_PROFILE_COMPONENT=comp)
         try:
             r = subprocess.run(
@@ -189,8 +330,11 @@ def main() -> int:
             print(f"{comp}: no JSON (rc={r.returncode})", file=sys.stderr)
     if not results:
         return 1
+    if args.fine and args.out == "STEP_PROFILE.json":
+        args.out = "STEP_PROFILE_FINE.json"  # don't clobber the coarse run
     out = {
         "instance": args.instance,
+        "fine": args.fine,
         "k": args.k,
         "node_ascent": args.node_ascent,
         "method": "chained transfer-free dispatches, one readback per "
