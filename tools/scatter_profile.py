@@ -1,0 +1,383 @@
+"""A/B microbenchmark of B&B push (scatter-insert) variants on the live
+backend — the fine step profile (STEP_PROFILE_FINE_TPU.json) showed the
+push owns ~6.5 ms of the 9.9 ms expansion step (6 scatters ~4.2 ms, the
+six [order] re-order gathers ~2.3 ms), so this sizes the fix before it
+lands in `_expand_step`.
+
+Variants (identical resulting frontier contents where noted):
+
+  v0_order_scatter   - the current engine push: 6 gathers by `order` +
+                       6 scatters at ordered-cumsum dest (baseline)
+  v1_invperm_scatter - NO reorder gathers: dest computed per-candidate in
+                       unordered space via the analytic inverse of the
+                       two-level priority permutation (inv argsorts +
+                       1-D flag scatter + cumsum + 1-D gather); then the
+                       same 6 row scatters. Bit-identical frontier to v0.
+  v2_packed_scatter  - v1 but the six SoA buffers are packed into ONE
+                       [cap, n+W+4] i32 buffer (f32 fields bitcast), so
+                       the push is ONE row scatter. Tests whether scatter
+                       cost is per-op or per-row.
+  v3_gather_dus      - compaction by ONE gather of packed rows by `order`
+                       + a contiguous dynamic_update_slice of the whole
+                       k*n block at the stack top (garbage above n_push
+                       is beyond `count`, never read; needs k*n headroom).
+
+Method: same transfer-free chained-dispatch protocol as step_profile.py
+(one subprocess per variant, one readback at the end).
+
+NOTE: this experiment drove the round-4 packed-frontier refactor — the
+engine's Frontier is now the packed layout itself (v2 is the production
+push). v0/v1 reconstruct the round-3 six-array SoA layout locally (as a
+script-level namedtuple) so the A/B stays reproducible.
+
+Usage: python tools/scatter_profile.py [eil51] [--k=1024]
+Writes SCATTER_PROFILE.json.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+VARIANTS = ("v0_order_scatter", "v1_invperm_scatter", "v2_packed_scatter",
+            "v3_gather_dus")
+
+
+def child(args) -> int:
+    comp = os.environ["TSP_SCATTER_VARIANT"]
+    from tsp_mpi_reduction_tpu.utils.backend import (
+        enable_persistent_cache,
+        select_backend,
+    )
+
+    platform = select_backend(args.backend)
+    enable_persistent_cache(platform)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tsp_mpi_reduction_tpu.models import branch_bound as bb
+    from tsp_mpi_reduction_tpu.utils import tsplib
+
+    inst = tsplib.embedded(args.instance)
+    d = inst.distance_matrix()
+    n = d.shape[0]
+    k = args.k
+    capacity = max(1 << 17, 8 * k * (n - 1))
+    dev = jax.devices()[0]
+
+    bd = bb._bound_setup(d, "one-tree", node_ascent=2, ascent="host")
+    integral = bool(bd.integral)
+    d64 = np.asarray(d, np.float64)
+    tour = bb.nearest_neighbor_tour(d64)
+    inc_cost = jnp.asarray(bb.tour_cost(d64, tour), jnp.float32)
+    inc_tour = jnp.asarray(tour, jnp.int32)
+    fr = bb.make_root_frontier(n, capacity, np.asarray(bd.min_out, np.float64))
+    d32 = jnp.asarray(d, jnp.float32)
+
+    # warm to a realistic mid-search frontier, device-resident
+    fr, inc_cost, inc_tour, _ = bb._expand_loop(
+        fr, inc_cost, inc_tour, d32, bd.min_out, bd.bound_adj, bd.dbar,
+        bd.pi, bd.slack, bd.ascent_step, bd.lam_budget, k, n,
+        args.warm_steps, integral, True, 2, "prim",
+    )
+
+    from typing import NamedTuple
+
+    class SoAF(NamedTuple):
+        """The round-3 six-array SoA frontier layout (v0/v1 baseline)."""
+
+        path: jnp.ndarray
+        mask: jnp.ndarray
+        depth: jnp.ndarray
+        cost: jnp.ndarray
+        bound: jnp.ndarray
+        sum_min: jnp.ndarray
+        count: jnp.ndarray
+        overflow: jnp.ndarray
+
+    # materialized copies of the warm frontier's logical fields ("+ 0"
+    # forces real buffers, not lazy views)
+    soa_fr = SoAF(
+        fr.path + 0, fr.mask + 0, fr.depth + 0, fr.cost + 0.0,
+        fr.bound + 0.0, fr.sum_min + 0.0, fr.count, fr.overflow,
+    )
+
+    f_cap = fr.path.shape[0]
+    W = fr.mask.shape[1]
+    lanes = jnp.arange(k, dtype=jnp.int32)
+    cities = jnp.arange(n, dtype=jnp.int32)
+    _, word_idx, bit, set_bit = bb._mask_consts(n)
+    kn = k * n
+
+    # packed layout for v2/v3: [cap, n + W + 4] i32
+    # cols: path[0:n] | mask[n:n+W] | depth | cost | bound | sum (bitcast)
+    def pack_frontier(f):
+        return jnp.concatenate(
+            [
+                f.path,
+                f.mask.astype(jnp.int32),
+                f.depth[:, None],
+                jax.lax.bitcast_convert_type(f.cost, jnp.int32)[:, None],
+                jax.lax.bitcast_convert_type(f.bound, jnp.int32)[:, None],
+                jax.lax.bitcast_convert_type(f.sum_min, jnp.int32)[:, None],
+            ],
+            axis=1,
+        )
+
+    packed0 = pack_frontier(fr) if comp in ("v2_packed_scatter",
+                                            "v3_gather_dus") else None
+
+    packed_variant = comp in ("v2_packed_scatter", "v3_gather_dus")
+
+    def stage_once(f, packed, c):
+        take = jnp.minimum(f.count, k)
+        idx = jnp.maximum(f.count - 1 - lanes, 0)
+        live = lanes < take
+        if packed_variant:
+            # pop FROM the packed carry: the scatter/DUS under test feeds
+            # the next iteration's gather, so XLA cannot dead-code it
+            # (an earlier harness popped stale f.nodes — the write was a
+            # dead carry and DCE-able; flagged in review, re-measured)
+            pr = packed[idx]
+            p_path = pr[:, :n]
+            p_mask = pr[:, n : n + W].astype(jnp.uint32)
+            p_depth = pr[:, n + W]
+            p_cost = (
+                jax.lax.bitcast_convert_type(pr[:, n + W + 1], jnp.float32)
+                + c * 0.0
+            )
+            p_bound = jax.lax.bitcast_convert_type(
+                pr[:, n + W + 2], jnp.float32
+            )
+            p_sum = jax.lax.bitcast_convert_type(
+                pr[:, n + W + 3], jnp.float32
+            )
+        else:
+            p_path = f.path[idx]
+            p_mask = f.mask[idx]
+            p_depth = f.depth[idx]
+            p_cost = f.cost[idx] + c * 0.0
+            p_bound = f.bound[idx]
+            p_sum = f.sum_min[idx]
+        if integral:
+            live = live & (p_bound <= c - 1.0)
+        else:
+            live = live & (p_bound < c)
+        cur = p_path[lanes, jnp.maximum(p_depth - 1, 0)]
+        unvis = (p_mask[:, word_idx] >> bit[None, :]) & 1 == 0
+        feasible = unvis & live[:, None]
+        ccost = p_cost[:, None] + d32[cur]
+        cbound = ccost + p_sum[:, None] + bd.bound_adj[None, :]
+        cdepth = p_depth[:, None] + 1
+        is_complete = (cdepth == n) & feasible
+        total = ccost + d32[cities, 0][None, :]
+        comp_total = jnp.where(is_complete, total, bb.INF)
+        new_inc = jnp.minimum(c, jnp.min(comp_total))
+        if integral:
+            push = feasible & ~is_complete & (cbound <= new_inc - 1.0)
+        else:
+            push = feasible & ~is_complete & (cbound < new_inc)
+        child_mask = p_mask[:, None, :] | set_bit[None, :, :]
+        child_sum = p_sum[:, None] - bd.min_out[None, :]
+        child_path = jnp.broadcast_to(p_path[:, None, :], (k, n, n))
+        child_path = jnp.where(
+            (jnp.arange(n)[None, None, :]
+             == jnp.minimum(p_depth[:, None, None], n - 1)),
+            cities[None, :, None],
+            child_path,
+        )
+
+        keys = jnp.where(push, cbound, -bb.INF)
+        child_ord = jnp.argsort(-keys, axis=1)  # [k, n]
+        best_child = jnp.min(jnp.where(push, cbound, bb.INF), axis=1)
+        parent_key = jnp.where(jnp.isfinite(best_child), best_child, -bb.INF)
+        parent_ord = jnp.argsort(-parent_key)  # [k]
+        base = f.count - take
+
+        if comp == "v0_order_scatter":
+            order = (parent_ord[:, None] * n + child_ord[parent_ord]).reshape(-1)
+            flat_push_o = push.reshape(-1)[order]
+            n_push = flat_push_o.sum()
+            dest = base + jnp.cumsum(flat_push_o.astype(jnp.int32)) - 1
+            dest = jnp.where(flat_push_o, dest, f_cap)
+            dest = jnp.minimum(dest, f_cap)
+
+            def scat(buf, vals):
+                return buf.at[dest].set(vals[order], mode="drop")
+
+            nf = SoAF(
+                scat(f.path, child_path.reshape(-1, n)),
+                scat(f.mask, child_mask.reshape(-1, W)),
+                scat(f.depth, jnp.broadcast_to(cdepth, (k, n)).reshape(-1)),
+                scat(f.cost, ccost.reshape(-1)),
+                scat(f.bound, cbound.reshape(-1)),
+                scat(f.sum_min, child_sum.reshape(-1)),
+                jnp.minimum(base + n_push.astype(jnp.int32), f_cap),
+                f.overflow | (base + n_push > f_cap),
+            )
+            return nf, packed, new_inc
+
+        # v1/v2/v3: analytic inverse of the two-level permutation.
+        # inv_parent[p] = rank of parent p in parent_ord;
+        # inv_child[p, c] = rank of child c within parent p's ordering.
+        # priority_pos[p, c] = inv_parent[p] * n + inv_child[p, c]
+        # == the position candidate (p, c) holds in v0's `order`.
+        inv_parent = jnp.zeros(k, jnp.int32).at[parent_ord].set(
+            jnp.arange(k, dtype=jnp.int32)
+        )
+        inv_child = jnp.zeros((k, n), jnp.int32).at[
+            jnp.arange(k, dtype=jnp.int32)[:, None], child_ord
+        ].set(jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32), (k, n)))
+        prio = (inv_parent[:, None] * n + inv_child).reshape(-1)  # [kn]
+        flat_push = push.reshape(-1)
+        # pushed-count prefix over priority order, read back per candidate:
+        # flags_in_order[j] = is the j-th-priority candidate pushed?
+        flags_in_order = (
+            jnp.zeros(kn, jnp.int32).at[prio].set(flat_push.astype(jnp.int32))
+        )
+        csum = jnp.cumsum(flags_in_order)
+        rank = csum[prio] - 1  # rank among pushed, in priority order
+        n_push = flat_push.sum()
+        dest = jnp.where(flat_push, base + rank, f_cap)
+        dest = jnp.minimum(dest, f_cap)
+
+        if comp == "v1_invperm_scatter":
+            def scat(buf, vals):
+                return buf.at[dest].set(vals, mode="drop")
+
+            nf = SoAF(
+                scat(f.path, child_path.reshape(-1, n)),
+                scat(f.mask, child_mask.reshape(-1, W)),
+                scat(f.depth, jnp.broadcast_to(cdepth, (k, n)).reshape(-1)),
+                scat(f.cost, ccost.reshape(-1)),
+                scat(f.bound, cbound.reshape(-1)),
+                scat(f.sum_min, child_sum.reshape(-1)),
+                jnp.minimum(base + n_push.astype(jnp.int32), f_cap),
+                f.overflow | (base + n_push > f_cap),
+            )
+            return nf, packed, new_inc
+
+        # packed candidate rows [kn, n+W+4] i32
+        cand = jnp.concatenate(
+            [
+                child_path.reshape(-1, n),
+                child_mask.reshape(-1, W).astype(jnp.int32),
+                jnp.broadcast_to(cdepth, (k, n)).reshape(-1)[:, None],
+                jax.lax.bitcast_convert_type(ccost.reshape(-1), jnp.int32)[:, None],
+                jax.lax.bitcast_convert_type(cbound.reshape(-1), jnp.int32)[:, None],
+                jax.lax.bitcast_convert_type(child_sum.reshape(-1), jnp.int32)[:, None],
+            ],
+            axis=1,
+        )
+        if comp == "v2_packed_scatter":
+            new_packed = packed.at[dest].set(cand, mode="drop")
+            cnt = jnp.minimum(base + n_push.astype(jnp.int32), f_cap)
+            nf = f._replace(count=cnt)
+            return nf, new_packed, new_inc
+
+        # v3: gather packed rows into priority order, then one DUS block.
+        # order[j] = index of the j-th-priority candidate (inverse of prio)
+        order = jnp.zeros(kn, jnp.int32).at[prio].set(
+            jnp.arange(kn, dtype=jnp.int32)
+        )
+        block = cand[order]  # [kn, n+W+4] — pushed rows form the prefix
+        start = jnp.minimum(base, f_cap - kn)  # stay in bounds (headroom)
+        new_packed = jax.lax.dynamic_update_slice(packed, block, (start, 0))
+        cnt = jnp.minimum(base + n_push.astype(jnp.int32), f_cap)
+        nf = f._replace(count=cnt)
+        return nf, new_packed, new_inc
+
+    dummy = (jnp.zeros((1, 1), jnp.int32) if packed0 is None else packed0)
+    state0 = soa_fr if comp in ("v0_order_scatter", "v1_invperm_scatter") else fr
+
+    @jax.jit
+    def dispatch(carry):
+        def body(_, fpc):
+            return stage_once(*fpc)
+
+        _, _, c = jax.lax.fori_loop(0, args.steps, body, (state0, dummy, carry))
+        return c
+
+    t0 = time.perf_counter()
+    c = dispatch(inc_cost * 1.0)
+    jax.block_until_ready(c)
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(args.dispatches):
+        c = dispatch(c)
+    final = float(c)
+    wall = time.perf_counter() - t0
+    ms = wall * 1000.0 / (args.dispatches * args.steps)
+    print(json.dumps({
+        "variant": comp,
+        "ms_per_step": round(ms, 4),
+        "dispatches": args.dispatches,
+        "steps_per_dispatch": args.steps,
+        "compile_s": round(compile_s, 1),
+        "final_value": final,
+        "device": str(dev),
+    }))
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("instance", nargs="?", default="eil51")
+    ap.add_argument("--backend", default="auto")
+    ap.add_argument("--k", type=int, default=1024)
+    ap.add_argument("--warm-steps", type=int, default=10)
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--dispatches", type=int, default=12)
+    ap.add_argument("--out", default="SCATTER_PROFILE.json")
+    ap.add_argument("--only", default=None,
+                    help="comma-separated subset of variants")
+    args = ap.parse_args()
+
+    if "TSP_SCATTER_VARIANT" in os.environ:
+        return child(args)
+
+    variants = VARIANTS if not args.only else tuple(args.only.split(","))
+    results = {}
+    for comp in variants:
+        env = dict(os.environ, TSP_SCATTER_VARIANT=comp)
+        try:
+            r = subprocess.run(
+                [sys.executable] + sys.argv, capture_output=True,
+                text=True, env=env, timeout=1800,
+            )
+        except subprocess.TimeoutExpired:
+            print(f"{comp}: subprocess timed out", file=sys.stderr)
+            continue
+        sys.stderr.write(r.stderr[-2000:])
+        try:
+            results[comp] = json.loads(r.stdout.strip().splitlines()[-1])
+            print(f"{comp}: {results[comp]['ms_per_step']} ms/step",
+                  file=sys.stderr)
+        except (json.JSONDecodeError, IndexError):
+            print(f"{comp}: no JSON (rc={r.returncode})", file=sys.stderr)
+    if not results:
+        return 1
+    out = {
+        "instance": args.instance,
+        "k": args.k,
+        "method": "chained transfer-free dispatches, one readback per "
+        "variant subprocess; no MST chain (push machinery only, "
+        "comparable to STEP_PROFILE_FINE scatter=6.87ms)",
+        "variants": results,
+    }
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

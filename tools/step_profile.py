@@ -36,16 +36,24 @@ sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
 COMPONENTS = ("full_prim", "full_boruvka", "nomst", "bound_prim", "bound_boruvka")
 
+#: `guarded` times _guarded_expand_steps (the _solve_device loop body:
+#: per-step compaction cond + full-stop cond around the expansion) —
+#: `guarded - full_prim` attributes the guard machinery itself
+EXTRA_COMPONENTS = ("guarded",)
+
 #: finer-grained slices of the nomst step (--fine): each adds one stage
-#: on top of the previous, so successive differences attribute the step:
-#:   popgather       - pop gathers + unvis + child cost/bound/mask/path
-#:                     materialization (no sort, no scatter)
-#:   sort            - popgather + the two-level priority argsorts + the
-#:                     flattened push order
-#:   scatter_noorder - popgather + compaction cumsum + the six scatter
-#:                     pushes of UNORDERED children (no [order] gather)
-#:   scatter         - the full nomst step body (== nomst, cross-check)
-FINE_COMPONENTS = ("popgather", "sort", "scatter_noorder", "scatter")
+#: on top of the previous, so successive differences attribute the step
+#: (stages mirror the PACKED-frontier push — the round-4 layout):
+#:   popgather - packed-row pop gather + unvis + child cost/bound/mask/
+#:               path materialization (no sort, no scatter)
+#:   sort      - popgather + the two-level priority argsorts + the
+#:               analytic inverse-permutation dest computation
+#:   scatter   - the full nomst step body: + the single packed-row
+#:               scatter push (== nomst, cross-check)
+#: The round-3 six-array SoA layout's numbers (6 scatters 4.5 ms, +order
+#: gathers 6.9 ms vs 0.42 ms packed) are in STEP_PROFILE_FINE_TPU.json /
+#: SCATTER_PROFILE_TPU.json — the evidence that drove the packed layout.
+FINE_COMPONENTS = ("popgather", "sort", "scatter")
 
 
 def child(args) -> int:
@@ -121,19 +129,24 @@ def child(args) -> int:
         _, word_idx, bit, set_bit = bb._mask_consts(n)
         integral_f = bool(integral)
 
+        w = (n + 31) // 32
+        kn = k * n
+
         def stage_once(f, c):
             take = jnp.minimum(f.count, k)
             idx = jnp.maximum(f.count - 1 - lanes, 0)
             live = lanes < take
+            p = f.nodes[idx]  # one packed-row gather
+            p_path = p[:, :n]
+            p_mask = p[:, n : n + w].astype(jnp.uint32)
+            p_depth = p[:, n + w]
+            p_cost = bb._f32(p[:, n + w + 1]) + c * 0.0  # carry dependency
+            p_bound = bb._f32(p[:, n + w + 2])
+            p_sum = bb._f32(p[:, n + w + 3])
             if integral_f:
-                live = live & (f.bound[idx] <= c - 1.0)
+                live = live & (p_bound <= c - 1.0)
             else:
-                live = live & (f.bound[idx] < c)
-            p_path = f.path[idx]
-            p_mask = f.mask[idx]
-            p_depth = f.depth[idx]
-            p_cost = f.cost[idx] + c * 0.0  # carry dependency
-            p_sum = f.sum_min[idx]
+                live = live & (p_bound < c)
             cur = p_path[lanes, jnp.maximum(p_depth - 1, 0)]
             unvis = (p_mask[:, word_idx] >> bit[None, :]) & 1 == 0
             feasible = unvis & live[:, None]
@@ -165,53 +178,51 @@ def child(args) -> int:
                     + jnp.sum(child_sum)
                 )
                 return f, jnp.minimum(new_inc, jnp.abs(s) + 1e6)
-            if comp == "scatter_noorder":
-                flat_push_o = push.reshape(-1)
-                vals_path = child_path.reshape(-1, n)
-                vals_mask = child_mask.reshape(-1, child_mask.shape[-1])
-                vals_depth = jnp.broadcast_to(cdepth, (k, n)).reshape(-1)
-                vals_cost = ccost.reshape(-1)
-                vals_bound = cbound.reshape(-1)
-                vals_sum = child_sum.reshape(-1)
-            else:  # sort / scatter: the two-level priority order
-                keys = jnp.where(push, cbound, -bb.INF)
-                child_ord = jnp.argsort(-keys, axis=1)
-                best_child = jnp.min(jnp.where(push, cbound, bb.INF), axis=1)
-                parent_key = jnp.where(
-                    jnp.isfinite(best_child), best_child, -bb.INF
-                )
-                parent_ord = jnp.argsort(-parent_key)
-                order = (
-                    parent_ord[:, None] * n + child_ord[parent_ord]
-                ).reshape(-1)
-                if comp == "sort":
-                    s = (order[0] + order[-1]).astype(jnp.float32)
-                    return f, jnp.minimum(new_inc, jnp.abs(s) + 1e6)
-                flat_push_o = push.reshape(-1)[order]
-                vals_path = child_path.reshape(-1, n)[order]
-                vals_mask = child_mask.reshape(-1, child_mask.shape[-1])[order]
-                vals_depth = jnp.broadcast_to(cdepth, (k, n)).reshape(-1)[order]
-                vals_cost = ccost.reshape(-1)[order]
-                vals_bound = cbound.reshape(-1)[order]
-                vals_sum = child_sum.reshape(-1)[order]
+            # the two-level priority order + analytic inverse-perm dest
+            keys = jnp.where(push, cbound, -bb.INF)
+            child_ord = jnp.argsort(-keys, axis=1)
+            best_child = jnp.min(jnp.where(push, cbound, bb.INF), axis=1)
+            parent_key = jnp.where(
+                jnp.isfinite(best_child), best_child, -bb.INF
+            )
+            parent_ord = jnp.argsort(-parent_key)
+            inv_parent = jnp.zeros(k, jnp.int32).at[parent_ord].set(
+                jnp.arange(k, dtype=jnp.int32)
+            )
+            inv_child = jnp.zeros((k, n), jnp.int32).at[
+                jnp.arange(k, dtype=jnp.int32)[:, None], child_ord
+            ].set(jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32), (k, n)))
+            prio = (inv_parent[:, None] * n + inv_child).reshape(-1)
+            flat_push = push.reshape(-1)
+            flags_in_order = (
+                jnp.zeros(kn, jnp.int32)
+                .at[prio]
+                .set(flat_push.astype(jnp.int32))
+            )
+            csum = jnp.cumsum(flags_in_order)
+            rank = csum[prio] - 1
+            n_push = flat_push.sum()
             base = f.count - take
-            dest = base + jnp.cumsum(flat_push_o.astype(jnp.int32)) - 1
-            dest = jnp.where(flat_push_o, dest, f_cap)
+            dest = jnp.where(flat_push, base + rank, f_cap)
             dest = jnp.minimum(dest, f_cap)
-            n_push = flat_push_o.sum()
-            new_path = f.path.at[dest].set(vals_path, mode="drop")
-            new_mask = f.mask.at[dest].set(vals_mask, mode="drop")
-            new_depth = f.depth.at[dest].set(vals_depth, mode="drop")
-            new_cost = f.cost.at[dest].set(vals_cost, mode="drop")
-            new_bound = f.bound.at[dest].set(vals_bound, mode="drop")
-            new_sum = f.sum_min.at[dest].set(vals_sum, mode="drop")
+            if comp == "sort":
+                s = (dest[0] + dest[-1] + n_push).astype(jnp.float32)
+                return f, jnp.minimum(new_inc, jnp.abs(s) + 1e6)
+            cand = jnp.concatenate(
+                [
+                    child_path.reshape(-1, n),
+                    child_mask.reshape(-1, w).astype(jnp.int32),
+                    jnp.broadcast_to(cdepth, (k, n)).reshape(-1)[:, None],
+                    bb._i32(ccost.reshape(-1))[:, None],
+                    bb._i32(cbound.reshape(-1))[:, None],
+                    bb._i32(child_sum.reshape(-1))[:, None],
+                ],
+                axis=1,
+            )
+            new_nodes = f.nodes.at[dest].set(cand, mode="drop")
             new_count = jnp.minimum(base + n_push.astype(jnp.int32), f_cap)
             overflow = f.overflow | (base + n_push > f_cap)
-            nf = bb.Frontier(
-                new_path, new_mask, new_depth, new_cost, new_bound,
-                new_sum, new_count, overflow,
-            )
-            return nf, new_inc
+            return bb.Frontier(new_nodes, new_count, overflow), new_inc
 
         @jax.jit
         def dispatch(carry):
@@ -219,6 +230,19 @@ def child(args) -> int:
                 0, args.steps, lambda _, fc: stage_once(*fc), (fr, carry)
             )
             return c
+
+    elif comp == "guarded":
+        units_per_dispatch = args.steps
+
+        @jax.jit
+        def dispatch(carry):
+            _, ic2, _, _, _, _ = bb._guarded_expand_steps(
+                fr, carry, inc_tour, d32, bd.min_out, bd.bound_adj,
+                bd.dbar, bd.pi, bd.slack, bd.ascent_step, bd.lam_budget,
+                jnp.asarray(args.steps, jnp.int32), k, n, integral, True,
+                na, 0, jnp.asarray(0, jnp.int32), kern,
+            )
+            return ic2
 
     elif comp.startswith("full") or comp == "nomst":
         units_per_dispatch = args.steps
@@ -301,6 +325,10 @@ def main() -> int:
                     help="bound evals per timed dispatch (bound-only)")
     ap.add_argument("--dispatches", type=int, default=12)
     ap.add_argument("--out", default="STEP_PROFILE.json")
+    ap.add_argument("--only", default=None,
+                    help="comma-separated subset of components (any of "
+                    "COMPONENTS/FINE_COMPONENTS/EXTRA_COMPONENTS, e.g. "
+                    "--only=guarded,full_prim)")
     ap.add_argument("--fine", action="store_true",
                     help="profile the staged slices of the nomst step "
                     "(popgather/sort/scatter) instead of the coarse "
@@ -311,7 +339,15 @@ def main() -> int:
         return child(args)
 
     results = {}
-    for comp in (FINE_COMPONENTS if args.fine else COMPONENTS):
+    if args.only:
+        todo = tuple(args.only.split(","))
+        bad = set(todo) - set(COMPONENTS + FINE_COMPONENTS + EXTRA_COMPONENTS)
+        if bad:
+            print(f"unknown components: {sorted(bad)}", file=sys.stderr)
+            return 2
+    else:
+        todo = FINE_COMPONENTS if args.fine else COMPONENTS
+    for comp in todo:
         env = dict(os.environ, TSP_PROFILE_COMPONENT=comp)
         try:
             r = subprocess.run(
