@@ -1,0 +1,124 @@
+"""Unit tests for the packed-frontier layout (round 4).
+
+The engine stores the B&B frontier as ONE [F, n + W + 4] int32 buffer
+(branch_bound.Frontier); these tests pin the layout invariants the rest
+of the code relies on: the width inversion, the host pack/unpack
+round-trip, the property views, and bitcast exactness for every f32
+value class (the bound comparisons must see the EXACT stored floats).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from tsp_mpi_reduction_tpu.models import branch_bound as bb
+
+
+def test_layout_inverts_width_for_all_supported_n():
+    for n in range(3, bb.MAX_BNB_CITIES + 1):
+        w = (n + 31) // 32
+        assert bb._layout(n + w + 4) == (n, w)
+
+
+def test_layout_rejects_impossible_width():
+    # n + ceil(n/32) + 4 skips some integers (e.g. the step at n=32->33
+    # adds 2); such widths have no valid layout
+    valid = {n + (n + 31) // 32 + 4 for n in range(1, 400)}
+    for cols in range(8, 120):
+        if cols not in valid:
+            with pytest.raises(ValueError):
+                bb._layout(cols)
+            return
+    pytest.skip("no invalid width in range (unexpected)")
+
+
+def _random_fields(rng, m, n):
+    w = (n + 31) // 32
+    return {
+        "path": rng.integers(0, n, size=(m, n)).astype(np.int32),
+        "mask": rng.integers(0, 2**32, size=(m, w), dtype=np.uint64).astype(
+            np.uint32
+        ),
+        "depth": rng.integers(1, n + 1, size=m).astype(np.int32),
+        "cost": rng.normal(size=m).astype(np.float32) * 1e3,
+        "bound": rng.normal(size=m).astype(np.float32) * 1e3,
+        "sum_min": rng.normal(size=m).astype(np.float32) * 1e3,
+    }
+
+
+def test_pack_unpack_roundtrip_bit_exact():
+    rng = np.random.default_rng(0)
+    for n in (3, 31, 32, 33, 51, 100, 200):
+        f = _random_fields(rng, 17, n)
+        # exercise every f32 value class, incl. the sign of zero and inf
+        f["bound"][0] = np.float32(np.inf)
+        f["bound"][1] = np.float32(-0.0)
+        f["cost"][2] = np.float32(np.nan)
+        rows = bb._pack_rows_np(
+            f["path"], f["mask"], f["depth"], f["cost"], f["bound"], f["sum_min"]
+        )
+        assert rows.dtype == np.int32
+        back = bb._unpack_rows_np(rows)
+        for k in f:
+            # bit-level equality (NaN-safe): compare the raw words
+            a = np.asarray(f[k])
+            b = np.asarray(back[k])
+            assert a.dtype == b.dtype, k
+            assert np.array_equal(
+                a.view(np.int32) if a.dtype != np.int32 else a,
+                b.view(np.int32) if b.dtype != np.int32 else b,
+            ), k
+
+
+def test_property_views_match_unpack():
+    rng = np.random.default_rng(1)
+    n = 51
+    f = _random_fields(rng, 9, n)
+    rows = bb._pack_rows_np(
+        f["path"], f["mask"], f["depth"], f["cost"], f["bound"], f["sum_min"]
+    )
+    fr = bb.Frontier(
+        jnp.asarray(rows), jnp.asarray(9, jnp.int32), jnp.asarray(False)
+    )
+    assert np.array_equal(np.asarray(fr.path), f["path"])
+    assert np.array_equal(np.asarray(fr.mask), f["mask"])
+    assert np.array_equal(np.asarray(fr.depth), f["depth"])
+    for k in ("cost", "bound", "sum_min"):
+        assert np.array_equal(
+            np.asarray(getattr(fr, k)).view(np.int32),
+            f[k].view(np.int32),
+        ), k
+
+
+def test_property_views_on_stacked_rank_dim():
+    # the sharded path stacks [R, F, cols]; the ellipsis-based views must
+    # keep leading dims
+    rng = np.random.default_rng(2)
+    n = 14
+    f = _random_fields(rng, 6, n)
+    rows = bb._pack_rows_np(
+        f["path"], f["mask"], f["depth"], f["cost"], f["bound"], f["sum_min"]
+    )
+    stacked = np.stack([rows, rows + 0])
+    fr = bb.Frontier(
+        jnp.asarray(stacked),
+        jnp.asarray([6, 6], jnp.int32),
+        jnp.asarray([False, False]),
+    )
+    assert fr.path.shape == (2, 6, n)
+    assert fr.bound.shape == (2, 6)
+    assert np.array_equal(np.asarray(fr.path)[1], f["path"])
+
+
+def test_make_root_frontier_views():
+    min_out = np.asarray([0.0, 1.5, 2.5, 3.0], np.float64)
+    fr = bb.make_root_frontier(4, 32, min_out)
+    assert int(fr.count) == 1
+    assert not bool(fr.overflow)
+    assert int(fr.depth[0]) == 1
+    assert int(fr.mask[0, 0]) == 1  # city 0 visited
+    assert float(fr.cost[0]) == 0.0
+    assert float(fr.bound[0]) == 0.0
+    assert float(fr.sum_min[0]) == np.float32(min_out[1:].sum())
+    # dead rows are all-zero == float 0.0 fields
+    assert float(fr.bound[5]) == 0.0
