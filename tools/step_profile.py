@@ -123,7 +123,6 @@ def child(args) -> int:
         # coarse 'nomst' by those (small, fixed) costs — a known
         # methodological offset in the cross-check, not noise.
         units_per_dispatch = args.steps
-        f_cap = fr.path.shape[0]
         lanes = jnp.arange(k, dtype=jnp.int32)
         cities = jnp.arange(n, dtype=jnp.int32)
         _, word_idx, bit, set_bit = bb._mask_consts(n)
@@ -203,10 +202,8 @@ def child(args) -> int:
             rank = csum[prio] - 1
             n_push = flat_push.sum()
             base = f.count - take
-            dest = jnp.where(flat_push, base + rank, f_cap)
-            dest = jnp.minimum(dest, f_cap)
             if comp == "sort":
-                s = (dest[0] + dest[-1] + n_push).astype(jnp.float32)
+                s = (rank[0] + rank[-1] + n_push + base).astype(jnp.float32)
                 return f, jnp.minimum(new_inc, jnp.abs(s) + 1e6)
             cand = jnp.concatenate(
                 [
@@ -219,9 +216,19 @@ def child(args) -> int:
                 ],
                 axis=1,
             )
-            new_nodes = f.nodes.at[dest].set(cand, mode="drop")
-            new_count = jnp.minimum(base + n_push.astype(jnp.int32), f_cap)
-            overflow = f.overflow | (base + n_push > f_cap)
+            # production push: compacting gather + contiguous block write
+            f_phys = f.nodes.shape[0]
+            f_log = max(f_phys - kn, 1)
+            comp_idx = jnp.zeros(kn, jnp.int32).at[
+                jnp.where(flat_push, rank, kn)
+            ].set(jnp.arange(kn, dtype=jnp.int32), mode="drop")
+            block = cand[comp_idx]
+            start = jnp.minimum(base, f_phys - kn)
+            new_nodes = jax.lax.dynamic_update_slice(
+                f.nodes, block, (start, jnp.zeros((), start.dtype))
+            )
+            new_count = jnp.minimum(base + n_push.astype(jnp.int32), f_log)
+            overflow = f.overflow | (base + n_push > f_log)
             return bb.Frontier(new_nodes, new_count, overflow), new_inc
 
         @jax.jit

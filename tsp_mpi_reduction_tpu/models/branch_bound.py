@@ -879,7 +879,20 @@ def _expand_step(
     reduced-cost MST bound (_batched_mst_bound) before expanding it; nodes
     that fail are discarded without spawning children.
     """
-    f_cap = fr.nodes.shape[0]
+    # the buffer carries k*n rows of write padding beyond the logical
+    # capacity (make_root_frontier pad_rows): the push lands as ONE
+    # contiguous dynamic_update_slice of the whole candidate block at the
+    # stack top, which always fits while count <= logical capacity. A
+    # caller that didn't pad just loses k*n slots of usable capacity.
+    f_phys = fr.nodes.shape[0]
+    if f_phys <= k * n:
+        # the k*n-row block write cannot fit at all — an opaque XLA shape
+        # error otherwise; surface the actionable config problem instead
+        raise ValueError(
+            f"frontier buffer has {f_phys} rows but the push block needs "
+            f"k*n = {k * n} (+>=1 logical slot); lower k or raise capacity"
+        )
+    f_cap = f_phys - k * n  # logical capacity
     w = (n + 31) // 32
     lanes = jnp.arange(k, dtype=jnp.int32)
     # pop the top-of-stack K entries (stack grows upward): ONE row gather
@@ -1000,14 +1013,9 @@ def _expand_step(
     csum = jnp.cumsum(flags_in_order)
     rank = csum[prio] - 1  # rank among pushed candidates, priority order
     n_push = flat_push.sum()
-
     base = fr.count - take
-    dest = jnp.where(flat_push, base + rank, f_cap)  # dead rows: off-end
-    dest = jnp.minimum(dest, f_cap)  # scatter drop mode ignores off-end
 
-    # ONE packed row scatter (2.3 ms vs 6.9 ms for six SoA scatters on
-    # the real chip, live-carry A/B — the payload columns mirror the
-    # Frontier layout)
+    # the payload columns mirror the Frontier layout
     cand = jnp.concatenate(
         [
             child_path.reshape(-1, n),
@@ -1019,10 +1027,28 @@ def _expand_step(
         ],
         axis=1,
     )
-    new_nodes = fr.nodes.at[dest].set(cand, mode="drop")
+    # push = compacting gather + ONE contiguous block write (on-chip
+    # live-carry A/B: 1.46 ms vs 2.32 ms for the row scatter and 6.9 ms
+    # for the round-3 six-scatter form): gather the pushed candidates to
+    # the block prefix in priority order, then dynamic_update_slice the
+    # whole k*n block at the stack top. Rows past n_push are garbage —
+    # they land beyond the new count and every consumer masks by count.
+    comp_idx = jnp.zeros(kn, jnp.int32).at[
+        jnp.where(flat_push, rank, kn)
+    ].set(jnp.arange(kn, dtype=jnp.int32), mode="drop")
+    block = cand[comp_idx]
+    # while the count<=f_cap invariant holds, base+kn <= f_phys and the
+    # clamp is a no-op; if a caller breaks it (e.g. resuming a checkpoint
+    # with a larger k), the clamped write overlaps live rows — flag it so
+    # exactness loss is never silent (same honesty as scatter-drop was)
+    start = jnp.minimum(base, f_phys - kn)
+    # literal 0 would trace as int64 under x64 mode; match start's dtype
+    new_nodes = jax.lax.dynamic_update_slice(
+        fr.nodes, block, (start, jnp.zeros((), start.dtype))
+    )
 
     new_count = base + n_push.astype(jnp.int32)
-    overflow = fr.overflow | (new_count > f_cap)
+    overflow = fr.overflow | (new_count > f_cap) | (base > f_phys - kn)
     new_count = jnp.minimum(new_count, f_cap)
 
     stats = {"popped": take, "pushed": n_push, "completions": is_complete.sum()}
@@ -1089,7 +1115,7 @@ def _expand_loop(
     return fr, inc_cost, inc_tour, nodes
 
 
-def _reorder_frontier(fr: Frontier) -> Frontier:
+def _reorder_frontier(fr: Frontier, rows=None) -> Frontier:
     """Globally re-sort the live stack so the LOWEST-bound node sits on
     top (popped next): one argsort + gather turns the depth-first stack
     into best-bound-first search until dives re-bury it.
@@ -1101,40 +1127,54 @@ def _reorder_frontier(fr: Frontier) -> Frontier:
     full-frontier gather to keep expanding the bound-critical nodes,
     which is what raises the certified LB on gap-reporting runs
     (kroA100, VERDICT r3 item 7). Ordering is search priority only;
-    exactness is unaffected."""
-    f_cap = fr.nodes.shape[0]
-    pos = jnp.arange(f_cap, dtype=jnp.int32)
+    exactness is unaffected.
+
+    ``rows``: static logical-prefix length — sort only the slots that
+    can ever be counted and skip the k*n push-padding tail (callers that
+    know k pass ``buffer_rows - k*n``; None sorts the whole buffer)."""
+    rows = fr.nodes.shape[0] if rows is None else rows
+    live_nodes = fr.nodes[:rows]
+    pos = jnp.arange(rows, dtype=jnp.int32)
     live = pos < fr.count
+    n, w = _layout(fr.nodes.shape[-1])
     # DESC by bound: worst live node at index 0, best at count-1 (stack
     # top), dead entries (-inf keys) pushed past the live prefix
-    perm = jnp.argsort(-jnp.where(live, fr.bound, -INF))
-    return Frontier(fr.nodes[perm], fr.count, fr.overflow)
+    key = _f32(live_nodes[:, n + w + 2])
+    perm = jnp.argsort(-jnp.where(live, key, -INF))
+    return Frontier(
+        fr.nodes.at[:rows].set(live_nodes[perm]), fr.count, fr.overflow
+    )
 
 
 #: host-loop callers re-sort between dispatches (device_loop mode sorts
 #: inside the kernel instead)
-_reorder_frontier_jit = jax.jit(_reorder_frontier)
+_reorder_frontier_jit = jax.jit(_reorder_frontier, static_argnames=("rows",))
 
 
-def _compact_frontier(fr: Frontier, inc_cost, integral: bool) -> Frontier:
+def _compact_frontier(fr: Frontier, inc_cost, integral: bool, rows=None) -> Frontier:
     """Drop pruned nodes from the device stack IN PLACE (stable order).
 
     The on-device replacement for most host-reservoir spills: as the
     incumbent improves, the stack bottom fills with nodes whose bound can
     no longer win; a prefix-sum scatter squeezes them out without any
     host round trip. Exactness is preserved — only certified-prunable
-    nodes are discarded.
+    nodes are discarded. ``rows``: as in :func:`_reorder_frontier`.
     """
-    f_cap = fr.nodes.shape[0]
-    pos = jnp.arange(f_cap, dtype=jnp.int32)
+    rows = fr.nodes.shape[0] if rows is None else rows
+    live_nodes = fr.nodes[:rows]
+    pos = jnp.arange(rows, dtype=jnp.int32)
     live = pos < fr.count
+    n, w = _layout(fr.nodes.shape[-1])
+    bound = _f32(live_nodes[:, n + w + 2])
     if integral:
-        alive = live & (fr.bound <= inc_cost - 1.0)
+        alive = live & (bound <= inc_cost - 1.0)
     else:
-        alive = live & (fr.bound < inc_cost)
-    dest = jnp.where(alive, jnp.cumsum(alive.astype(jnp.int32)) - 1, f_cap)
+        alive = live & (bound < inc_cost)
+    dest = jnp.where(alive, jnp.cumsum(alive.astype(jnp.int32)) - 1, rows)
     return Frontier(
-        fr.nodes.at[dest].set(fr.nodes, mode="drop"),
+        fr.nodes.at[:rows].set(
+            live_nodes.at[dest].set(live_nodes, mode="drop")
+        ),
         alive.sum().astype(jnp.int32),
         fr.overflow,
     )
@@ -1215,7 +1255,9 @@ def _guarded_expand_steps(
     ``k*(n-1)``, which is exactly the headroom the caller's
     ``capacity >= 4*k*(n-1)`` precondition reserves.
     """
-    f_cap = fr.nodes.shape[0]
+    # logical capacity: the buffer's trailing k*n rows are the push
+    # block's write padding (see _expand_step), never counted slots
+    f_cap = max(fr.nodes.shape[0] - k * n, 1)
     headroom = min(f_cap // 4, k * (n - 1))
 
     def cond(carry):
@@ -1235,13 +1277,13 @@ def _guarded_expand_steps(
             # than the period
             fr = jax.lax.cond(
                 ((step0 + i) % reorder_every) == (reorder_every - 1),
-                _reorder_frontier,
+                lambda f: _reorder_frontier(f, rows=f_cap),
                 lambda f: f,
                 fr,
             )
         fr = jax.lax.cond(
             fr.count > f_cap - headroom,
-            lambda f, c: _compact_frontier(f, c, integral),
+            lambda f, c: _compact_frontier(f, c, integral, rows=f_cap),
             lambda f, c: f,
             fr,
             ic,
@@ -1325,10 +1367,14 @@ class _Reservoir:
             fr.overflow,
         )
 
-    def refill(self, fr: Frontier, inc_cost: float, integral: bool) -> Frontier:
-        """Reload up to half the capacity from the reservoir onto an empty
-        device stack, dropping nodes the incumbent has since closed."""
-        capacity = fr.nodes.shape[0]
+    def refill(
+        self, fr: Frontier, inc_cost: float, integral: bool, capacity: int
+    ) -> Frontier:
+        """Reload up to half the LOGICAL capacity from the reservoir onto
+        an empty device stack, dropping nodes the incumbent has since
+        closed. ``capacity`` is the logical slot count, REQUIRED — the
+        buffer's own row count includes push-padding rows and would
+        over-fill (eroding the spill-headroom invariant)."""
         host = np.asarray(fr.nodes).copy()
         take = self.refill_host(host, capacity, inc_cost, integral)
         if take == 0:
@@ -1374,7 +1420,13 @@ class _Reservoir:
         return take
 
 
-def make_root_frontier(n: int, capacity: int, min_out: np.ndarray, dtype=jnp.float32) -> Frontier:
+def make_root_frontier(
+    n: int, capacity: int, min_out: np.ndarray, dtype=jnp.float32,
+    pad_rows: int = 0,
+) -> Frontier:
+    """Root frontier with ``capacity`` logical slots plus ``pad_rows``
+    extra buffer rows (callers pass ``k*n`` so _expand_step's contiguous
+    block write always fits — see the push comment there)."""
     if dtype != jnp.float32:
         raise ValueError("the packed frontier stores float32 fields only")
     w = (n + 31) // 32
@@ -1386,7 +1438,7 @@ def make_root_frontier(n: int, capacity: int, min_out: np.ndarray, dtype=jnp.flo
     row0[n] = 1  # mask word 0: city 0 visited
     row0[n + w] = 1  # depth
     row0[n + w + 3] = np.float32(min_out[1:].sum()).view(np.int32)
-    nodes = jnp.zeros((capacity, n + w + 4), jnp.int32).at[0].set(row0)
+    nodes = jnp.zeros((capacity + pad_rows, n + w + 4), jnp.int32).at[0].set(row0)
     return Frontier(nodes, jnp.asarray(1, jnp.int32), jnp.asarray(False))
 
 
@@ -1499,8 +1551,9 @@ def warm_compile_device_solver(
     w = (n + 31) // 32
     sd = jax.ShapeDtypeStruct
     f32, i32 = jnp.float32, jnp.int32
+    # + k*n push-padding rows, matching solve()'s make_root_frontier call
     fr = Frontier(
-        sd((capacity, n + w + 4), i32), sd((), i32), sd((), jnp.bool_)
+        sd((capacity + k * n, n + w + 4), i32), sd((), i32), sd((), jnp.bool_)
     )
     _solve_device.lower(
         fr, sd((), f32), sd((n + 1,), i32), sd((n, n), f32), sd((n,), f32),
@@ -1603,10 +1656,20 @@ def solve(
         fr, inc_cost, inc_tour, reservoir = restore(
             resume_from, expect_d=d, expect_bound=bound
         )
-        # the restored arrays define the true capacity — the caller's
-        # argument must not disarm the spill trigger below (and the
-        # device_loop guard must re-check against THIS capacity)
-        capacity = int(fr.path.shape[0])
+        # the restored arrays define the true LOGICAL capacity (buffer
+        # rows minus the k*n push padding _expand_step reserves) — the
+        # caller's argument must not disarm the spill trigger below (and
+        # the device_loop guard must re-check against THIS capacity)
+        capacity = max(int(fr.nodes.shape[0]) - k * n, 1)
+        if int(fr.count) > capacity - _spill_headroom(
+            capacity, inner_steps, k, n
+        ):
+            # checkpoint written with a smaller k (or pre-padding layout):
+            # a restored count inside the spill band would let the FIRST
+            # (unguarded, host-loop) batch overflow the logical capacity
+            # and trip the sticky exactness-lost flag — shed to the
+            # reservoir before any dispatch instead
+            fr = reservoir.spill(fr, keep=capacity // 2)
         device_loop = _resolve_device_loop(
             device_loop, auto_device_loop, capacity, k, n,
             source=f" from checkpoint {resume_from!r}",
@@ -1619,7 +1682,7 @@ def solve(
             tour_cost(np.asarray(d, np.float64), inc_tour_np), jnp.float32
         )
         inc_tour = jnp.asarray(inc_tour_np, jnp.int32)
-        fr = make_root_frontier(n, capacity, min_out_np)
+        fr = make_root_frontier(n, capacity, min_out_np, pad_rows=k * n)
 
     headroom = _spill_headroom(capacity, inner_steps, k, n)
     t0 = time.perf_counter()
@@ -1696,7 +1759,7 @@ def solve(
             last_inc = ic
             t_best = time.perf_counter() - t0
         if cnt == 0 and len(reservoir):
-            fr = reservoir.refill(fr, ic, integral)
+            fr = reservoir.refill(fr, ic, integral, capacity=capacity)
             cnt = int(fr.count)
         elif cnt > capacity - headroom:
             fr = reservoir.spill(fr, keep=capacity // 2)
@@ -1705,7 +1768,7 @@ def solve(
             and not device_loop
             and it - last_reorder >= reorder_every
         ):
-            fr = _reorder_frontier_jit(fr)
+            fr = _reorder_frontier_jit(fr, rows=capacity)
             last_reorder = it
         # checkpoint AFTER the spill/refill: a pre-spill snapshot could be
         # resumed into an immediate in-kernel overflow
@@ -1859,8 +1922,10 @@ def solve_sharded(
             s_cost[slot] = d_np[0, c]
             s_bound[slot] = d_np[0, c] + sum_min0 + float(bound_adj[c])
             s_sum[slot] = sum_min0 - min_out_np[c]
+        rows = _pack_rows_np(s_path, s_mask, s_depth, s_cost, s_bound, s_sum)
+        # + k*n push-padding rows per rank (see _expand_step's block write)
         seed_nodes.append(
-            _pack_rows_np(s_path, s_mask, s_depth, s_cost, s_bound, s_sum)
+            np.concatenate([rows, np.zeros((k * n, rows.shape[1]), np.int32)])
         )
         seed_counts.append(np.int32(len(mine)))
     spec = NamedSharding(mesh, P(RANK_AXIS))
@@ -1876,11 +1941,12 @@ def solve_sharded(
         ic = jax.device_put(np.asarray(ic_h), spec)
         itour = jax.device_put(np.asarray(itour_h), spec)
         inc_cost0 = float(np.asarray(ic_h)[0])
-        # the restored arrays define the true per-rank capacity — the
-        # caller's argument must not disarm the spill trigger below (and
-        # the device_loop floor must re-check against THIS capacity)
-        # static shape only — never materialize the packed buffer for this
-        capacity_per_rank = int(fr_h.nodes.shape[1])
+        # the restored arrays define the true per-rank LOGICAL capacity
+        # (buffer rows minus the k*n push padding) — the caller's argument
+        # must not disarm the spill trigger below (and the device_loop
+        # floor must re-check against THIS capacity). Static shape only —
+        # never materialize the packed buffer for this
+        capacity_per_rank = max(int(fr_h.nodes.shape[1]) - k * n, 1)
         device_loop = _resolve_device_loop(
             device_loop, auto_device_loop, capacity_per_rank, k, n,
             what="capacity_per_rank",
@@ -1981,7 +2047,12 @@ def solve_sharded(
         shard_map(
             lambda fr_stacked: jax.tree.map(
                 lambda x: x[None],
-                tuple(_reorder_frontier(Frontier(*(x[0] for x in fr_stacked)))),
+                tuple(
+                    _reorder_frontier(
+                        Frontier(*(x[0] for x in fr_stacked)),
+                        rows=capacity_per_rank,
+                    )
+                ),
             ),
             mesh=mesh,
             in_specs=(tuple(P(RANK_AXIS) for _ in Frontier._fields),),
@@ -2121,6 +2192,14 @@ def solve_sharded(
             fr.overflow,
         )
         return stacked, int(new_counts.sum())
+
+    if resume_from:
+        # a checkpoint written with a smaller k (or the pre-padding
+        # layout) can restore counts above this run's logical capacity;
+        # shed the overhang to the reservoirs BEFORE the first dispatch
+        # (the unguarded host-loop expand would otherwise be forced to
+        # clamp its block write and flag exactness lost)
+        fr, _ = spill_refill(fr, inc_cost0)
 
     t0 = time.perf_counter()
     setup_s = t0 - t_setup
