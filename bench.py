@@ -136,16 +136,23 @@ def bench_bnb() -> int:
     # per-node mini-ascent depth: more steps = fewer nodes but more Prims
     # per pop; the best time-to-proof point is hardware-dependent
     na = int(os.environ.get("TSP_BENCH_NODE_ASCENT", "2"))
-    # MST bound kernel: prim (sequential chain) or boruvka (log-depth
-    # batched rounds — built for the TPU's latency profile)
-    mk = os.environ.get("TSP_BENCH_MST_KERNEL", "prim")
+    # MST bound kernel: prim (sequential jnp chain), boruvka (log-depth
+    # batched rounds — recorded negative result), or prim_pallas (the
+    # whole chain fused into one Pallas kernel — 0.74 vs 2.92 ms per
+    # bound eval on a v5e). Default: prim_pallas on TPU backends (n is
+    # within the kernel's 256-lane limit for every embedded instance),
+    # prim elsewhere (interpret mode would be slower than jnp on CPU).
+    on_cpu = jax.default_backend() == "cpu"
+    on_tpu = jax.default_backend() == "tpu"
+    mk = os.environ.get(
+        "TSP_BENCH_MST_KERNEL", "prim_pallas" if on_tpu else "prim"
+    )
     if mk not in bb._MST_CONN:
         print(
             f"bench: TSP_BENCH_MST_KERNEL={mk!r} is not one of "
             f"{sorted(bb._MST_CONN)}", file=sys.stderr,
         )
         return 2
-    on_cpu = jax.default_backend() == "cpu"
 
     t0 = time.perf_counter()
     if on_cpu:

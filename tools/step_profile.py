@@ -92,6 +92,8 @@ def child(args) -> int:
     d32 = jnp.asarray(d, jnp.float32)
 
     kern = "boruvka" if comp.endswith("boruvka") else "prim"
+    if args.mst_kernel:
+        kern = args.mst_kernel  # e.g. prim_pallas (overrides the default)
     use_mst = comp not in ("nomst",) + FINE_COMPONENTS
 
     # warm: advance the root frontier to a realistic mid-search state
@@ -325,6 +327,9 @@ def main() -> int:
     ap.add_argument("--backend", default="auto")
     ap.add_argument("--k", type=int, default=1024)
     ap.add_argument("--node-ascent", type=int, default=2)
+    ap.add_argument("--mst-kernel", default=None,
+                    help="override the MST kernel for full_*/bound_*/"
+                    "guarded components (e.g. prim_pallas)")
     ap.add_argument("--warm-steps", type=int, default=10)
     ap.add_argument("--steps", type=int, default=10,
                     help="expansion steps per timed dispatch")

@@ -759,7 +759,24 @@ def _mst_conn_boruvka(dbar, unvis, cur, n, lam=None):
 #: log-depth batched variant built for the TPU's latency profile — select
 #: it explicitly (--mst-kernel / TSP_BENCH_MST_KERNEL); it is NOT chosen
 #: automatically on any backend (and is ~10x slower on a scalar CPU)
-_MST_CONN = {"prim": _mst_conn, "boruvka": _mst_conn_boruvka}
+def _mst_conn_prim_pallas(dbar, unvis, cur, n, lam=None):
+    """Prim MST(U) with the n-1 step chain fused into ONE Pallas kernel
+    (ops/prim_pallas — the chain is op-issue-latency-bound as XLA ops;
+    see BENCHMARKS.md round-4 step attribution). Bit-identical (tot, deg)
+    to _mst_conn; the connection edges stay in jnp, shared with every
+    kernel."""
+    from ..ops.prim_pallas import prim_chain
+
+    tot, deg = prim_chain(dbar, unvis, n, lam)
+    conn, bump = _conn_edges(dbar, unvis, cur, n, lam)
+    return tot + conn, deg + bump
+
+
+_MST_CONN = {
+    "prim": _mst_conn,
+    "boruvka": _mst_conn_boruvka,
+    "prim_pallas": _mst_conn_prim_pallas,
+}
 
 
 def _batched_mst_bound(

@@ -1,0 +1,200 @@
+"""Pallas TPU kernel for the B&B engine's vmapped Prim MST chain.
+
+The per-node MST re-bound (models/branch_bound._mst_conn) is the
+expansion step's dominant cost after the round-4 packed-frontier work:
+n-1 SEQUENTIAL fori iterations of tiny [k, n] ops, ~58 us each on a
+v5e — latency-bound on op-issue overhead, not compute (the on-chip step
+attribution in BENCHMARKS.md; a lax.fori unroll was tried and rejected).
+This module runs the ENTIRE chain inside one Pallas kernel: the loop
+state (intree/mind/closest/deg/tot) lives in registers/VMEM for a
+[TK, LW] row-tile and the n-1 iterations execute back-to-back with no
+XLA op boundaries.
+
+Bit-exactness contract (validated against _mst_conn in
+tests/test_prim_pallas.py, INTERPRET mode): identical (tot, deg) —
+  - same sequential f32 accumulation order for ``tot``;
+  - same argmin/argmax tie-breaking (first index) in interpret mode.
+    COMPILED Mosaic argmin breaks ties differently: when an MST has
+    equal-weight edge choices the DEGREES (and thus the mini-ascent
+    subgradients and search trajectory) can differ from the jnp chain —
+    every choice is an MST of identical total weight, so the value and
+    the bound stay certified (same documented effect as the Boruvka
+    kernel; eil51 expands 153,897 vs prim's 153,747 nodes, both proving
+    426). Runs remain deterministic per backend;
+  - lane padding to LW columns carries unvis=False -> +inf edge rows,
+    which can never win an argmin that has any finite candidate, and the
+    all-inf case picks index 0 in both paths;
+  - the dbar row select uses a one-hot f32 matmul against a ZERO-padded
+    dbar tile: one-hot weights are exactly 0.0/1.0, so each output
+    element is one exact f32 pass-through plus exact zeros (the MXU's
+    bf16x3 f32 emulation reconstructs b_hi + b_lo = b exactly for
+    a = 1.0) — no rounding enters the bound.
+
+Like ops/held_karp_pallas.py, the kernel is OPT-IN
+(``--mst-kernel=prim_pallas`` / TSP_BENCH_MST_KERNEL) and falls back to
+interpret mode off-TPU so the parity tests run on CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+ROW_TILE = 128  # k rows per grid step
+
+
+def _lanes_for(n: int) -> int:
+    if n <= 128:
+        return 128
+    if n <= 256:
+        return 256
+    raise ValueError(f"prim_pallas supports n <= 256, got {n}")
+
+
+def _prim_kernel(unvis_ref, dbar_ref, lam_ref, tot_ref, deg_ref, *,
+                 n: int, has_lam: bool):
+    """One [TK, LW] row-tile: the full n-1 step Prim chain.
+
+    unvis_ref: [TK, LW] int32 0/1 (0 beyond column n)
+    dbar_ref:  [LW, LW] f32, ZERO-padded outside [n, n]
+    lam_ref:   [TK, LW] f32 per-lane potential deltas (zeros if unused)
+    tot_ref:   [TK, LW] f32 out — MST value broadcast across lanes
+    deg_ref:   [TK, LW] i32 out — per-vertex MST degree counts
+    """
+    tk, lw = unvis_ref.shape
+    unvis = unvis_ref[:] != 0
+    dbar = dbar_ref[:]
+    lam = lam_ref[:] if has_lam else None
+    big = jnp.float32(jnp.inf)
+    col = jax.lax.broadcasted_iota(jnp.int32, (tk, lw), 1)
+    colf = col.astype(jnp.float32)
+
+    # Mosaic hygiene (each bisected as a compiler crash on this image):
+    # every loop-state tensor stays rank-2; boolean planes never ride
+    # the fori carry (intree is int32); and index planes never broadcast
+    # as int32 through the carry — ``closest`` holds vertex ids as f32
+    # (exact: ids < 256 << 2^24), with one-hot tests against a float iota
+    def onehot(idx2):  # idx2: [TK, 1] int32
+        return col == idx2
+
+    def edge_rows(u2):
+        # dbar[u] via one-hot f32 matmul (exact — see module docstring)
+        oh = onehot(u2).astype(jnp.float32)
+        # HIGHEST precision is REQUIRED for exactness: the default dot
+        # truncates f32 operands to bf16 (one pass), which rounds values
+        # with >8 mantissa bits (e.g. 647 -> 648) and would corrupt the
+        # certified bound; the 3-pass path reconstructs b_hi + b_lo = b
+        # exactly under one-hot weights
+        base = jax.lax.dot_general(
+            oh, dbar, (((1,), (0,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32,
+        )
+        if lam is None:
+            return base
+        lam_u = jnp.sum(jnp.where(onehot(u2), lam, 0.0), axis=1,
+                        keepdims=True)
+        return base + lam_u + lam
+
+    # Mosaic's index-reductions only lower for f32 operands; 1.0/0.0
+    # argmax picks the first unvisited column exactly like bool argmax
+    start = jnp.argmax(unvis.astype(jnp.float32), axis=1).astype(
+        jnp.int32
+    )[:, None]
+    oh_start = onehot(start)
+    intree = oh_start.astype(jnp.int32)
+    mind = jnp.where(unvis, edge_rows(start), big)
+    startf = jnp.sum(jnp.where(oh_start, colf, 0.0), axis=1, keepdims=True)
+    closest = startf + colf * 0.0  # [TK, lw], every column = start id
+    deg = jnp.zeros((tk, lw), jnp.int32)
+    tot = jnp.zeros((tk, 1), jnp.float32)
+
+    def body(_, carry):
+        intree, mind, closest, deg, tot = carry
+        cand = jnp.where(intree != 0, big, mind)
+        u = jnp.argmin(cand, axis=1).astype(jnp.int32)[:, None]
+        oh_u = onehot(u)
+        uf = jnp.sum(jnp.where(oh_u, colf, 0.0), axis=1, keepdims=True)
+        wu = jnp.min(cand, axis=1, keepdims=True)
+        fin = jnp.isfinite(wu)
+        tot = tot + jnp.where(fin, wu, 0.0)
+        parf = jnp.sum(jnp.where(oh_u, closest, 0.0), axis=1, keepdims=True)
+        oh_par = colf == parf
+        one = fin.astype(jnp.int32)
+        deg = deg + (oh_u.astype(jnp.int32) + oh_par.astype(jnp.int32)) * one
+        intree = jnp.maximum(intree, oh_u.astype(jnp.int32))
+        row = jnp.where(unvis, edge_rows(u), big)
+        better = row < mind
+        closest = jnp.where(better, uf, closest)
+        mind = jnp.minimum(mind, row)
+        return intree, mind, closest, deg, tot
+
+    _, _, _, deg, tot = jax.lax.fori_loop(
+        0, n - 1, body, (intree, mind, closest, deg, tot)
+    )
+    tot_ref[:] = jnp.broadcast_to(tot, (tk, lw))
+    deg_ref[:] = deg
+
+
+@functools.partial(jax.jit, static_argnames=("n", "has_lam", "interpret"))
+def _prim_chain_padded(unvis_p, dbar_p, lam_p, n: int, has_lam: bool,
+                       interpret: bool):
+    kp, lw = unvis_p.shape
+    grid = kp // ROW_TILE
+    kernel = functools.partial(_prim_kernel, n=n, has_lam=has_lam)
+    tot, deg = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((ROW_TILE, lw), lambda i: (i, 0)),
+            pl.BlockSpec((lw, lw), lambda i: (0, 0)),
+            pl.BlockSpec((ROW_TILE, lw), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((ROW_TILE, lw), lambda i: (i, 0)),
+            pl.BlockSpec((ROW_TILE, lw), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((kp, lw), jnp.float32),
+            jax.ShapeDtypeStruct((kp, lw), jnp.int32),
+        ],
+        interpret=interpret,
+    )(unvis_p, dbar_p, lam_p)
+    return tot[:, 0], deg
+
+
+def prim_chain(
+    dbar: jnp.ndarray,
+    unvis: jnp.ndarray,
+    n: int,
+    lam: Optional[jnp.ndarray] = None,
+    interpret: Optional[bool] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(tot [k], deg [k, n]) of MST(U) per lane — the fori-loop portion of
+    branch_bound._mst_conn, bit-identical, as one Pallas dispatch."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    k = unvis.shape[0]
+    lw = _lanes_for(n)
+    kp = max((k + ROW_TILE - 1) // ROW_TILE, 1) * ROW_TILE
+    unvis_p = jnp.zeros((kp, lw), jnp.int32).at[:k, :n].set(
+        unvis.astype(jnp.int32)
+    )
+    dbar_p = jnp.zeros((lw, lw), jnp.float32).at[:n, :n].set(
+        dbar.astype(jnp.float32)
+    )
+    has_lam = lam is not None
+    if has_lam:
+        lam_p = jnp.zeros((kp, lw), jnp.float32).at[:k, :n].set(
+            lam.astype(jnp.float32)
+        )
+    else:
+        lam_p = jnp.zeros((kp, lw), jnp.float32)
+    tot, deg = _prim_chain_padded(unvis_p, dbar_p, lam_p, n, has_lam,
+                                  bool(interpret))
+    return tot[:k], deg[:k, :n]
