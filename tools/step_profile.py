@@ -93,6 +93,12 @@ def child(args) -> int:
 
     kern = "boruvka" if comp.endswith("boruvka") else "prim"
     if args.mst_kernel:
+        if args.mst_kernel not in bb._MST_CONN:
+            print(
+                f"--mst-kernel={args.mst_kernel!r} is not one of "
+                f"{sorted(bb._MST_CONN)}", file=sys.stderr,
+            )
+            return 2
         kern = args.mst_kernel  # e.g. prim_pallas (overrides the default)
     use_mst = comp not in ("nomst",) + FINE_COMPONENTS
 

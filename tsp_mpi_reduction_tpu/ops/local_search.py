@@ -37,7 +37,14 @@ def _reversal_deltas(t: jnp.ndarray, d: jnp.ndarray, closed: bool) -> jnp.ndarra
     n = t.shape[0]
     nxt = jnp.concatenate([t[1:], t[:1]])
     a, b = t, nxt  # edge i = (a[i], b[i]); edge n-1 is the wrap edge
-    da = d[a[:, None], a[None, :]] + d[b[:, None], b[None, :]]
+    # d[a_i, a_j] as TWO row gathers (d[a] then columns via transpose)
+    # instead of an n*n random ELEMENT gather — element gathers pay
+    # per-element on TPU and dominated the polish fold's 34.5 s wall;
+    # and since b is a's cyclic successor, d[b_i, b_j] is just the
+    # (-1, -1) roll of the same permuted matrix
+    daa = d[a].T[a].T
+    dbb = jnp.roll(daa, (-1, -1), (0, 1))
+    da = daa + dbb
     db = d[a, b][:, None] + d[a, b][None, :]
     delta = da - db
     i_ = jnp.arange(n)[:, None]
@@ -118,9 +125,14 @@ def _relocation_deltas(t: jnp.ndarray, d: jnp.ndarray, L: int) -> jnp.ndarray:
     succ = t[(ar + L) % n]
     jnxt = t[(ar + 1) % n]
     remove = d[pred, succ] - d[pred, t] - d[seg_end, succ]  # [i]
+    # both [n, n] terms come from ONE permuted matrix d_tt[i, j] =
+    # d[t[i], t[j]] (two row gathers — see _reversal_deltas on why
+    # element gathers are avoided): d[t[j], t[i]] is its transpose and
+    # d[seg_end_i, jnxt_j] its cyclic (-(L-1), -1) roll
+    d_tt = d[t].T[t].T
     splice = (
-        d[t[None, :], t[:, None]]  # d[t[j], t[i]] at [i, j]
-        + d[seg_end[:, None], jnxt[None, :]]
+        d_tt.T  # d[t[j], t[i]] at [i, j]
+        + jnp.roll(d_tt, (-(L - 1), -1), (0, 1))
         - d[t, jnxt][None, :]
     )
     delta = remove[:, None] + splice
