@@ -32,7 +32,12 @@ tests/test_prim_pallas.py, INTERPRET mode): identical (tot, deg) —
 
 Like ops/held_karp_pallas.py, the kernel is OPT-IN
 (``--mst-kernel=prim_pallas`` / TSP_BENCH_MST_KERNEL) and falls back to
-interpret mode off-TPU so the parity tests run on CPU.
+interpret mode off-TPU so the parity tests run on CPU. COMPILED use is
+limited to n <= 128: n=200 B&B runs crashed the TPU worker on this
+image with BOTH this kernel and the jnp prim (so the n>128-on-relay
+config is the hazard, not Mosaic) — a worker crash can forfeit the
+chip grant, so prim_chain refuses it loudly; n > 128 stays on the jnp
+'prim' kernel.
 """
 
 from __future__ import annotations
@@ -179,6 +184,18 @@ def prim_chain(
     branch_bound._mst_conn, bit-identical, as one Pallas dispatch."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if not interpret and n > 128:
+        # the 256-lane (n<=256) variant is interpret-validated, but
+        # n=200 B&B runs CRASH the TPU worker on this image ("TPU worker
+        # process crashed", 2026-07-31) — observed with BOTH this kernel
+        # and the plain jnp prim, so the fault is the n>128 config on
+        # this relay rather than Mosaic specifically. A worker crash can
+        # forfeit the chip grant, so refuse loudly rather than risk it;
+        # n > 128 stays on the jnp 'prim' kernel (CPU-validated path)
+        raise ValueError(
+            f"prim_pallas is limited to n <= 128 on compiled TPU (got "
+            f"n={n}); use --mst-kernel=prim for larger instances"
+        )
     k = unvis.shape[0]
     lw = _lanes_for(n)
     kp = max((k + ROW_TILE - 1) // ROW_TILE, 1) * ROW_TILE
