@@ -119,6 +119,26 @@ def test_spill_checkpoint_roundtrip(tmp_path):
     assert resumed.proven_optimal and resumed.cost == float(hk[0])
 
 
+def test_resume_with_larger_k_sheds_overhang(tmp_path):
+    """A checkpoint written at small k resumed with a LARGER k shrinks the
+    logical capacity (the buffer's trailing k*n rows are the push block's
+    write padding) — the pre-dispatch shed must spill the overhang to the
+    reservoir so the unguarded first batch can never clamp its block
+    write, and the resumed search must still prove the exact optimum."""
+    d = np.rint(random_d(12, 23) * 10)
+    hk, _ = solve_blocks_from_dists(d[None])
+    ck = str(tmp_path / "k_mismatch.npz")
+    partial = bb.solve(d, capacity=1024, k=8, inner_steps=1,
+                       bound="min-out", mst_prune=False, max_iters=60,
+                       checkpoint_path=ck)
+    assert not partial.proven_optimal
+    # k=32 -> k*n = 384 padding rows claimed out of the restored buffer
+    resumed = bb.solve(d, capacity=1024, k=32, inner_steps=1,
+                       bound="min-out", mst_prune=False,
+                       max_iters=2_000_000, resume_from=ck)
+    assert resumed.proven_optimal and resumed.cost == float(hk[0])
+
+
 def test_device_loop_checkpoint_cadence(tmp_path, monkeypatch):
     """ADVICE r3 (medium): periodic device_loop checkpointing must track
     steps-since-last-save, not a modulo of ``it`` — dispatches that stop
