@@ -78,7 +78,7 @@ if [ ! -s BENCH_KROA100_TPU.jsonl ]; then
     # compile), so the run is many short executions instead of one
     # unbounded one.
     rm -f /tmp/kroa_tpu_ck.npz
-    python tools/bnb_chunked.py kroA100 --chunk-iters=300 --max-chunks=40 \
+    python tools/bnb_chunked.py kroA100 --chunk-iters=300 --max-chunks=40 --mst-kernel=prim_pallas \
         --time-limit=420 --chunk-timeout=240 --checkpoint=/tmp/kroa_tpu_ck \
         --k=1024 --capacity=$((1<<19)) | tee BENCH_KROA100_TPU.tmp
     # completion = the driver's final summary line made it out; a partial
