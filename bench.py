@@ -66,6 +66,8 @@ N, BLOCKS, GRID = 16, 100, 1000
 #: generously assumes perfect 8-way MPI scaling of our own CPU rate.
 #: Measured 2026-07-30 at the current engine config (k=1024, node_ascent=2,
 #: f64 host ascent): 16,283 nodes/s, proof in 9.4 s; see BENCHMARKS.md.
+#: CAVEAT: a point host measurement — BENCHMARKS.md documents ±8% run-to-run
+#: drift on this shared host, so vs_baseline inherits that error bar.
 BNB_CPU_8RANK_ANCHOR = 8 * 16283.0
 
 #: fold names accepted by TSP_BENCH_FOLD, in measurement order.
@@ -84,37 +86,13 @@ POLISH_MAX_ROUNDS = 6
 
 
 def _accelerator_usable(timeout_s: float = 180.0) -> bool:
-    """Probe accelerator init in a subprocess (it can hang on a dead tunnel).
+    """Bounded probe for a usable accelerator; the real implementation moved
+    to utils.backend.accelerator_usable (round 5) so every entry point —
+    CLI, bnb_solve, sweep, profilers — shares the dead-grant hang guard this
+    bench always had, not just bench.py."""
+    from tsp_mpi_reduction_tpu.utils.backend import accelerator_usable
 
-    The remote-TPU ("axon") backend's first client creation performs a
-    claim/grant handshake that blocks indefinitely when no chip is currently
-    granted to this container; a subprocess probe with a timeout turns that
-    hang into a clean CPU fallback.
-    """
-    import subprocess
-
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices(); print('ok')"],
-            timeout=timeout_s,
-            capture_output=True,
-            text=True,
-        )
-        if r.returncode == 0 and "ok" in r.stdout:
-            return True
-        print(
-            f"bench: accelerator probe exited rc={r.returncode}: "
-            f"{(r.stderr or r.stdout).strip()[-300:]}",
-            file=sys.stderr,
-        )
-        return False
-    except subprocess.TimeoutExpired:
-        print(
-            f"bench: accelerator init timed out after {timeout_s:.0f}s "
-            "(claim/grant handshake never completed)",
-            file=sys.stderr,
-        )
-        return False
+    return accelerator_usable(timeout_s)
 
 
 def bench_bnb() -> int:
@@ -139,13 +117,16 @@ def bench_bnb() -> int:
     # MST bound kernel: prim (sequential jnp chain), boruvka (log-depth
     # batched rounds — recorded negative result), or prim_pallas (the
     # whole chain fused into one Pallas kernel — 0.74 vs 2.92 ms per
-    # bound eval on a v5e). Default: prim_pallas on TPU backends (n is
-    # within the kernel's 256-lane limit for every embedded instance),
-    # prim elsewhere (interpret mode would be slower than jnp on CPU).
+    # bound eval on a v5e). Default: prim_pallas on TPU backends for
+    # n <= 128 (the COMPILED kernel's lane limit — 256 lanes are
+    # interpret-only, prim_pallas.py docstring), falling back to prim for
+    # larger instances and everywhere off-TPU (interpret mode would be
+    # slower than jnp on CPU).
     on_cpu = jax.default_backend() == "cpu"
     on_tpu = jax.default_backend() == "tpu"
     mk = os.environ.get(
-        "TSP_BENCH_MST_KERNEL", "prim_pallas" if on_tpu else "prim"
+        "TSP_BENCH_MST_KERNEL",
+        "prim_pallas" if (on_tpu and n <= 128) else "prim",
     )
     if mk not in bb._MST_CONN:
         print(

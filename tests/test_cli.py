@@ -80,6 +80,73 @@ def test_metrics_flag_emits_json(capsys):
     assert m["cost"] > 0
 
 
+def test_select_backend_auto_dead_grant_falls_back(monkeypatch):
+    """--backend=auto with a registered remote plugin whose claim handshake
+    hangs (mocked via a sleeping probe subprocess) must fall back to CPU
+    within the probe timeout instead of hanging forever (VERDICT r4 weak #1:
+    bnb_solve sat >300 s on a dead grant)."""
+    import os
+    import time
+
+    from tsp_mpi_reduction_tpu.utils import backend
+
+    monkeypatch.setattr(backend, "_PROBE_CODE", "import time; time.sleep(60)")
+    monkeypatch.setattr(
+        backend, "_registered_platforms", lambda: {"cpu", "tpu", "axon"}
+    )
+    monkeypatch.setenv("TSP_BACKEND_PROBE_TIMEOUT", "2")
+    monkeypatch.delenv("TSP_BACKEND_PROBED", raising=False)
+    # un-pin the conftest's JAX_PLATFORMS=cpu so auto actually considers
+    # the (mock) remote accelerator rather than short-circuiting
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    t0 = time.monotonic()
+    try:
+        assert backend.select_backend("auto") == "cpu"
+    finally:
+        os.environ.pop("TSP_BACKEND_PROBED", None)
+    assert time.monotonic() - t0 < 30  # bounded, not the infinite hang
+
+
+def test_select_backend_tpu_dead_grant_raises(monkeypatch):
+    """--backend=tpu on a dead remote grant must raise cleanly (bounded by
+    the probe timeout), never enter the unguarded in-process init."""
+    import os
+
+    import pytest
+
+    from tsp_mpi_reduction_tpu.utils import backend
+
+    monkeypatch.setattr(backend, "_PROBE_CODE", "import time; time.sleep(60)")
+    monkeypatch.setattr(
+        backend, "_registered_platforms", lambda: {"cpu", "tpu", "axon"}
+    )
+    monkeypatch.setenv("TSP_BACKEND_PROBE_TIMEOUT", "2")
+    monkeypatch.delenv("TSP_BACKEND_PROBED", raising=False)
+    try:
+        with pytest.raises(RuntimeError, match="no accelerator platform"):
+            backend.select_backend("tpu")
+    finally:
+        os.environ.pop("TSP_BACKEND_PROBED", None)
+
+
+def test_accelerator_probe_accepts_only_noncpu_platforms(monkeypatch):
+    """The probe is platform-aware: a subprocess that comes up CPU-only
+    (e.g. grant lapsed between registration and init) is not 'usable'."""
+    import os
+
+    from tsp_mpi_reduction_tpu.utils import backend
+
+    monkeypatch.delenv("TSP_BACKEND_PROBED", raising=False)
+    monkeypatch.setattr(backend, "_PROBE_CODE", "print('PLATFORM=cpu')")
+    assert not backend.accelerator_usable(timeout_s=30)
+    monkeypatch.setattr(backend, "_PROBE_CODE", "print('PLATFORM=axon')")
+    try:
+        assert backend.accelerator_usable(timeout_s=30)
+        assert os.environ.get("TSP_BACKEND_PROBED") == "1"  # children skip
+    finally:
+        os.environ.pop("TSP_BACKEND_PROBED", None)
+
+
 def test_select_backend_tpu_detects_initialized_cpu_backend():
     """A cached CPU backend must not masquerade as a TPU (phantom-accelerator
     guard in select_backend's probe loop)."""

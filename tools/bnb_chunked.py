@@ -43,6 +43,12 @@ def main() -> int:
     ap.add_argument("--chunk-timeout", type=float, default=3600.0,
                     help="hard per-chunk wall cap (a lapsed chip grant "
                     "can hang a fresh client init forever)")
+    ap.add_argument("--lb-stall-gain", type=float, default=None,
+                    help="stop when the certified lower bound gains less "
+                    "than this per chunk, averaged over the last "
+                    "--lb-stall-chunks chunks (the run-to-exhaustion stop "
+                    "rule: a flattened climb is an answer, not a failure)")
+    ap.add_argument("--lb-stall-chunks", type=int, default=5)
     args, passthrough = ap.parse_known_args()
     if args.max_chunks < 1:
         ap.error("--max-chunks must be >= 1")
@@ -62,6 +68,9 @@ def main() -> int:
     tool = os.path.join(os.path.dirname(os.path.abspath(__file__)), "bnb_solve.py")
     t0 = time.perf_counter()
     last = None
+    lb_history: list = []
+    stalled = False
+    child_env = dict(os.environ)
     for chunk in range(1, args.max_chunks + 1):
         cmd = [
             sys.executable, tool, args.instance,
@@ -79,7 +88,7 @@ def main() -> int:
         try:
             r = subprocess.run(
                 cmd, capture_output=True, text=True,
-                timeout=args.chunk_timeout,
+                timeout=args.chunk_timeout, env=child_env,
             )
         except subprocess.TimeoutExpired:
             print(f"chunk {chunk}: timed out after {args.chunk_timeout:.0f}s",
@@ -93,11 +102,33 @@ def main() -> int:
             return 1
         last = json.loads(line)
         print(line)
+        # a chunk just ran on the backend — later chunks skip the
+        # accelerator probe subprocess (each probe is a full jax import
+        # plus a chip claim/release cycle: wasted wall and extra exposure
+        # to the grant-forfeit failure mode). A mid-run grant lapse is
+        # still bounded by --chunk-timeout.
+        child_env["TSP_BACKEND_PROBED"] = "1"
         elapsed = time.perf_counter() - t0
         if last["proven_optimal"]:
             break
         if args.time_limit is not None and elapsed > args.time_limit:
             break
+        if args.lb_stall_gain is not None and last["lower_bound"] is not None:
+            lb_history.append(float(last["lower_bound"]))
+            w = args.lb_stall_chunks
+            if (
+                len(lb_history) > w
+                and lb_history[-1] - lb_history[-1 - w]
+                < args.lb_stall_gain * w
+            ):
+                stalled = True
+                print(
+                    f"chunk {chunk}: LB climb flattened "
+                    f"(+{lb_history[-1] - lb_history[-1 - w]:.2f} over the "
+                    f"last {w} chunks < {args.lb_stall_gain}/chunk) — "
+                    "stopping at exhaustion", file=sys.stderr,
+                )
+                break
     assert last is not None
     print(json.dumps({
         "summary": True,
@@ -107,6 +138,7 @@ def main() -> int:
         "proven_optimal": last["proven_optimal"],
         "lower_bound": last["lower_bound"],
         "gap": last["gap"],
+        "lb_stalled": stalled,
         "total_wall_s": round(time.perf_counter() - t0, 1),
     }))
     return 0

@@ -61,6 +61,12 @@ def main() -> int:
         "recorded negative result); all certify the identical bound value",
     )
     ap.add_argument(
+        "--balance", default="pair", choices=["pair", "ring"],
+        help="sharded load-balance scheme: pair (richest donates to "
+        "poorest each round — O(1) flattening) or ring (successor "
+        "donation, the r4 scheme)",
+    )
+    ap.add_argument(
         "--reorder-every", type=int, default=0,
         help="every N expansion steps, re-sort the stack best-bound-first "
         "(raises the certified LB on gap-reporting runs; 0 = pure DFS)",
@@ -136,6 +142,7 @@ def main() -> int:
             device_loop={"auto": None, "on": True, "off": False}[args.device_loop],
             reorder_every=args.reorder_every,
             mst_kernel=args.mst_kernel,
+            balance=args.balance,
         )
     else:
         res = bb.solve(
@@ -190,6 +197,7 @@ def main() -> int:
                 ),
                 "bound": args.bound,
                 "mst_kernel": args.mst_kernel,
+                "balance": args.balance if args.ranks > 1 else None,
                 "root_lower_bound": round(res.root_lower_bound, 3),
                 # final certified LB (min over still-open nodes; = cost when
                 # proven) — the honest gap after the search, not the root's

@@ -391,6 +391,7 @@ def main() -> int:
         "fine": args.fine,
         "k": args.k,
         "node_ascent": args.node_ascent,
+        "mst_kernel": args.mst_kernel or "prim (default)",
         "method": "chained transfer-free dispatches, one readback per "
         "component subprocess; warmup drains into the first window "
         "(<=1/dispatches overstatement)",

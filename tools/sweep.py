@@ -47,6 +47,16 @@ def main() -> int:
         "--force", action="store_true",
         help="allow overwriting an existing large (non-quick) results file",
     )
+    # axis overrides (comma lists) for stated-subset sweeps — e.g. the TPU
+    # sweep runs all cities x all blocks at procs=8 (the north-star rank
+    # count) because 1200 distinct shapes = 1200 XLA compiles through the
+    # relay, which would eat the whole chip-grant window
+    ap.add_argument("--cities", default=None,
+                    help="comma list overriding the cities/block axis")
+    ap.add_argument("--blocks", default=None,
+                    help="comma list overriding the blocks axis")
+    ap.add_argument("--procs", default=None,
+                    help="comma list overriding the procs axis")
     args = ap.parse_args()
     if args.out is None:
         # quick smoke runs must not clobber the committed 1200-row artifact
@@ -67,6 +77,9 @@ def main() -> int:
             )
 
     platform = select_backend(args.backend)
+    from tsp_mpi_reduction_tpu.utils.backend import enable_persistent_cache
+
+    enable_persistent_cache(platform)  # re-sweeps skip the slow compiles
     dtype = args.dtype or ("float64" if platform == "cpu" else "float32")
     import jax
 
@@ -83,6 +96,12 @@ def main() -> int:
         cities = range(5, 11)
         blocks = range(10, 201, 10)
         procs = range(2, 21, 2)
+    if args.cities:
+        cities = [int(x) for x in args.cities.split(",")]
+    if args.blocks:
+        blocks = [int(x) for x in args.blocks.split(",")]
+    if args.procs:
+        procs = [int(x) for x in args.procs.split(",")]
 
     # resume: skip configs already in the CSV (a full sweep is hours; the
     # process may be restarted), identified by their first three columns
