@@ -1833,6 +1833,25 @@ def solve(
     )
 
 
+def _pair_assignment(all_c, round_i, num_ranks: int, t_slots: int):
+    """The pair-balance matching, as a pure function of the (invariant)
+    all-gathered counts: richest donates to poorest, 2nd-richest to
+    2nd-poorest, ... with a tie-break that rotates with ``round_i``.
+
+    Returns ``(m_of, partner_of)``: per-rank donation size and mirror
+    partner. Extracted from the shard_map closure so the starvation
+    properties are unit-testable without a mesh (tests/test_bnb.py).
+    """
+    rot = (jnp.arange(num_ranks, dtype=jnp.int32) + round_i) % num_ranks
+    order = jnp.lexsort((rot, -all_c))  # count desc, rotating ties
+    pos = jnp.argsort(order)  # pos[r] = rank r's position in that order
+    partner_of = order[num_ranks - 1 - pos]  # [R]: my mirror rank
+    donor = pos < (num_ranks // 2)  # odd R: middle rank pairs itself
+    gap = all_c - all_c[partner_of]
+    m_of = jnp.where(donor, jnp.clip(gap // 2, 0, t_slots), 0)  # [R]
+    return m_of, partner_of
+
+
 def solve_sharded(
     d: np.ndarray,
     mesh,
@@ -2044,13 +2063,7 @@ def solve_sharded(
         """
         cnt = f2.count
         all_c = jax.lax.all_gather(cnt, RANK_AXIS)  # [R], invariant
-        rot = (jnp.arange(num_ranks, dtype=jnp.int32) + round_i) % num_ranks
-        order = jnp.lexsort((rot, -all_c))  # count desc, rotating ties
-        pos = jnp.argsort(order)  # pos[r] = rank r's position in that order
-        partner_of = order[num_ranks - 1 - pos]  # [R]: my mirror rank
-        donor = pos < (num_ranks // 2)  # odd R: middle rank pairs itself
-        gap = all_c - all_c[partner_of]
-        m_of = jnp.where(donor, jnp.clip(gap // 2, 0, t_slots), 0)  # [R]
+        m_of, partner_of = _pair_assignment(all_c, round_i, num_ranks, t_slots)
         me = jax.lax.axis_index(RANK_AXIS)
         m_out = m_of[me]
         partner = partner_of[me]
