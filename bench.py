@@ -128,6 +128,11 @@ def bench_bnb() -> int:
         "TSP_BENCH_MST_KERNEL",
         "prim_pallas" if (on_tpu and n <= 128) else "prim",
     )
+    # push ordering: "best-first" (default) or "natural" (skip the
+    # per-step two-level sort: cheaper steps, possibly more nodes — on
+    # eil51 the ILS start is not optimal, so pop order does shape the
+    # tree; BENCH_BNB_TPU_R5_NOSORT.json is the on-chip A/B verdict)
+    po = os.environ.get("TSP_BENCH_PUSH_ORDER", "best-first")
     if mk not in bb._MST_CONN:
         print(
             f"bench: TSP_BENCH_MST_KERNEL={mk!r} is not one of "
@@ -140,19 +145,22 @@ def bench_bnb() -> int:
         # no relay, no poison: a tiny warmup run compiles the host-loop
         # kernels; the fine-grained host loop also honors time_limit_s
         bb.solve(d, capacity=capacity, k=k, node_ascent=na,
-                 device_loop=False, max_iters=8, mst_kernel=mk)
+                 device_loop=False, max_iters=8, mst_kernel=mk,
+                 push_order=po)
     else:
         # AOT compile only (no device execution -> the relay stays in fast
         # mode); integral must match what _bound_setup will derive from
         # the data or the timed dispatch recompiles a new static config
         bb.warm_compile_device_solver(
-            n, capacity, k, bb._is_integral(d), True, na, mst_kernel=mk
+            n, capacity, k, bb._is_integral(d), True, na, mst_kernel=mk,
+            push_order=po,
         )
     print(f"warmup (compile): {time.perf_counter() - t0:.1f}s", file=sys.stderr)
 
     res = bb.solve(
         d, capacity=capacity, k=k, time_limit_s=600, node_ascent=na,
         device_loop=not on_cpu, max_iters=5_000_000, mst_kernel=mk,
+        push_order=po,
     )
     ok = res.proven_optimal and res.cost == inst.known_optimum
     print(
@@ -189,6 +197,7 @@ def bench_bnb() -> int:
                 "setup_ascent_s": round(res.ascent_seconds, 2),
                 "setup_ils_s": round(res.ils_seconds, 2),
                 "mst_kernel": mk,
+                "push_order": po,
                 "anchor": (
                     "this engine's own 1-rank CPU rate x8 "
                     "(assumes perfect 8-way MPI scaling)"

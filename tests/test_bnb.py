@@ -217,6 +217,22 @@ def test_checkpoint_resume(tmp_path):
     assert abs(resumed.cost - float(hk[0])) < 1e-3
 
 
+def test_natural_push_order_same_proof():
+    """push_order="natural" (no per-step sort) must prove the same optimum
+    as best-first on both the host loop and the device loop (node counts
+    may differ — pop order shapes the tree while the incumbent is still
+    improving)."""
+    for seed in (0, 3):
+        d = np.rint(random_d(13, seed) * 10)
+        base = bb.solve(d, capacity=1 << 14, k=64, push_order="best-first")
+        nat = bb.solve(d, capacity=1 << 14, k=64, push_order="natural")
+        assert base.proven_optimal and nat.proven_optimal
+        assert nat.cost == base.cost
+        nat_dev = bb.solve(d, capacity=1 << 14, k=64, push_order="natural",
+                           device_loop=True)
+        assert nat_dev.proven_optimal and nat_dev.cost == base.cost
+
+
 def test_pair_assignment_rotation_starves_nobody():
     """The pair-balance matching must not deterministically starve a rank.
 

@@ -61,6 +61,13 @@ def main() -> int:
         "recorded negative result); all certify the identical bound value",
     )
     ap.add_argument(
+        "--push-order", default="best-first", choices=["best-first", "natural"],
+        help="per-step push ordering: best-first (two-level sort, stack "
+        "top = best child) or natural (no sort: cheaper steps but the "
+        "tree can grow when the incumbent improves mid-search; same "
+        "certified optimum either way)",
+    )
+    ap.add_argument(
         "--balance", default="pair", choices=["pair", "ring"],
         help="sharded load-balance scheme: pair (richest donates to "
         "poorest each round — O(1) flattening) or ring (successor "
@@ -143,6 +150,7 @@ def main() -> int:
             reorder_every=args.reorder_every,
             mst_kernel=args.mst_kernel,
             balance=args.balance,
+            push_order=args.push_order,
         )
     else:
         res = bb.solve(
@@ -160,6 +168,7 @@ def main() -> int:
             device_loop={"auto": None, "on": True, "off": False}[args.device_loop],
             reorder_every=args.reorder_every,
             mst_kernel=args.mst_kernel,
+            push_order=args.push_order,
         )
 
     opt = inst.known_optimum
@@ -197,6 +206,7 @@ def main() -> int:
                 ),
                 "bound": args.bound,
                 "mst_kernel": args.mst_kernel,
+                "push_order": args.push_order,
                 "balance": args.balance if args.ranks > 1 else None,
                 "root_lower_bound": round(res.root_lower_bound, 3),
                 # final certified LB (min over still-open nodes; = cost when

@@ -45,6 +45,13 @@ if [ ! -s BENCH_BNB_TPU_R5.json ]; then
     [ -s BENCH_BNB_TPU_R5.json ] || rm -f BENCH_BNB_TPU_R5.json
 fi
 
+if [ ! -s BENCH_BNB_TPU_R5_NOSORT.json ]; then
+    echo "== r5 B&B eil51, natural push order (sort-free step A/B) =="
+    TSP_BENCH=bnb TSP_BENCH_PUSH_ORDER=natural python bench.py \
+        2> >(tail -3 >&2) | tee BENCH_BNB_TPU_R5_NOSORT.json
+    [ -s BENCH_BNB_TPU_R5_NOSORT.json ] || rm -f BENCH_BNB_TPU_R5_NOSORT.json
+fi
+
 if [ "$(wc -l < BENCH_BNB_TPU_KSWEEP_R5.jsonl 2>/dev/null || echo 0)" -lt 4 ]; then
     echo "== r5 B&B eil51 k-sweep =="
     : > BENCH_BNB_TPU_KSWEEP_R5.tmp

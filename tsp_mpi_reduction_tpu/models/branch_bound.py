@@ -861,7 +861,8 @@ def _batched_mst_bound(
 @partial(
     jax.jit,
     static_argnames=(
-        "k", "n", "integral", "use_mst", "node_ascent", "mst_kernel"
+        "k", "n", "integral", "use_mst", "node_ascent", "mst_kernel",
+        "push_order",
     ),
 )
 def _expand_step(
@@ -882,6 +883,7 @@ def _expand_step(
     use_mst: bool = True,
     node_ascent: int = 0,
     mst_kernel: str = "prim",
+    push_order: str = "best-first",
 ):
     """Pop <=K nodes, expand, prune, push. Returns (frontier', inc', stats).
 
@@ -908,6 +910,13 @@ def _expand_step(
         raise ValueError(
             f"frontier buffer has {f_phys} rows but the push block needs "
             f"k*n = {k * n} (+>=1 logical slot); lower k or raise capacity"
+        )
+    if push_order not in ("best-first", "natural"):
+        # a typo'd value would otherwise silently run best-first while
+        # benchmark JSON records the bogus label — fail loudly like the
+        # sibling bound/balance/mst_kernel options do
+        raise ValueError(
+            f"unknown push_order {push_order!r} (expected best-first|natural)"
         )
     f_cap = f_phys - k * n  # logical capacity
     w = (n + 31) // 32
@@ -1002,33 +1011,46 @@ def _expand_step(
     # yields the same best-on-top stack discipline with two much smaller
     # sorts. Ordering only steers search priority; compaction correctness
     # is independent of it (dest slots come from the push-flag prefix sum).
-    keys = jnp.where(push, cbound, -INF)
-    child_ord = jnp.argsort(-keys, axis=1)  # [k, n] DESC, non-push last
-    best_child = jnp.min(jnp.where(push, cbound, INF), axis=1)
-    # parents DESC by best child (worst parent first, childless last), so
-    # the final pushes — the stack top — are the best parent's best child
-    parent_key = jnp.where(jnp.isfinite(best_child), best_child, -INF)
-    parent_ord = jnp.argsort(-parent_key)
-
-    # destination slots computed in UNORDERED candidate space via the
-    # analytic inverse of the two-level permutation — no 52k-row reorder
-    # gathers (on-chip A/B: they cost ~2.3 ms/step, SCATTER_PROFILE_TPU):
-    # prio[(p, c)] = the position candidate (p, c) holds in the ordered
-    # push sequence; its slot is base + (pushed candidates before it).
+    #
+    # push_order="natural" skips the ordering entirely (pushes land in
+    # candidate order): cheaper steps, but pop order steers the search,
+    # so the tree can GROW when the incumbent still improves mid-run
+    # (measured on eil51 CPU: 258k nodes natural vs ~222k best-first —
+    # the ILS start there is NOT optimal). Only when the incumbent is
+    # already optimal is the proof tree pop-order-invariant. Whether the
+    # per-step saving beats the extra nodes is an on-chip A/B question
+    # (BENCH_BNB_TPU_R5_NOSORT.json); gap-closing runs (LB climb) should
+    # keep "best-first" — the pop order steers the certified ascent.
     kn = k * n
-    inv_parent = jnp.zeros(k, jnp.int32).at[parent_ord].set(
-        jnp.arange(k, dtype=jnp.int32)
-    )
-    inv_child = jnp.zeros((k, n), jnp.int32).at[
-        jnp.arange(k, dtype=jnp.int32)[:, None], child_ord
-    ].set(jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32), (k, n)))
-    prio = (inv_parent[:, None] * n + inv_child).reshape(-1)  # [kn]
     flat_push = push.reshape(-1)
-    flags_in_order = (
-        jnp.zeros(kn, jnp.int32).at[prio].set(flat_push.astype(jnp.int32))
-    )
-    csum = jnp.cumsum(flags_in_order)
-    rank = csum[prio] - 1  # rank among pushed candidates, priority order
+    if push_order == "natural":
+        rank = jnp.cumsum(flat_push.astype(jnp.int32)) - 1
+    else:
+        keys = jnp.where(push, cbound, -INF)
+        child_ord = jnp.argsort(-keys, axis=1)  # [k, n] DESC, non-push last
+        best_child = jnp.min(jnp.where(push, cbound, INF), axis=1)
+        # parents DESC by best child (worst parent first, childless last), so
+        # the final pushes — the stack top — are the best parent's best child
+        parent_key = jnp.where(jnp.isfinite(best_child), best_child, -INF)
+        parent_ord = jnp.argsort(-parent_key)
+
+        # destination slots computed in UNORDERED candidate space via the
+        # analytic inverse of the two-level permutation — no 52k-row reorder
+        # gathers (on-chip A/B: they cost ~2.3 ms/step, SCATTER_PROFILE_TPU):
+        # prio[(p, c)] = the position candidate (p, c) holds in the ordered
+        # push sequence; its slot is base + (pushed candidates before it).
+        inv_parent = jnp.zeros(k, jnp.int32).at[parent_ord].set(
+            jnp.arange(k, dtype=jnp.int32)
+        )
+        inv_child = jnp.zeros((k, n), jnp.int32).at[
+            jnp.arange(k, dtype=jnp.int32)[:, None], child_ord
+        ].set(jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32), (k, n)))
+        prio = (inv_parent[:, None] * n + inv_child).reshape(-1)  # [kn]
+        flags_in_order = (
+            jnp.zeros(kn, jnp.int32).at[prio].set(flat_push.astype(jnp.int32))
+        )
+        csum = jnp.cumsum(flags_in_order)
+        rank = csum[prio] - 1  # rank among pushed candidates, priority order
     n_push = flat_push.sum()
     base = fr.count - take
 
@@ -1081,7 +1103,7 @@ def _expand_step(
     jax.jit,
     static_argnames=(
         "k", "n", "inner_steps", "integral", "use_mst", "node_ascent",
-        "mst_kernel",
+        "mst_kernel", "push_order",
     ),
 )
 def _expand_loop(
@@ -1103,6 +1125,7 @@ def _expand_loop(
     use_mst: bool = True,
     node_ascent: int = 0,
     mst_kernel: str = "prim",
+    push_order: str = "best-first",
 ):
     """Run up to ``inner_steps`` expansion steps in ONE device program.
 
@@ -1119,7 +1142,7 @@ def _expand_loop(
         fr, ic, itour, stats = _expand_step(
             fr, ic, itour, d, min_out, bound_adj, dbar, pi, mst_slack,
             ascent_step, lam_budget, k, n, integral, use_mst, node_ascent,
-            mst_kernel
+            mst_kernel, push_order
         )
         return fr, ic, itour, nodes + stats["popped"], i + 1
 
@@ -1201,7 +1224,7 @@ def _compact_frontier(fr: Frontier, inc_cost, integral: bool, rows=None) -> Fron
     jax.jit,
     static_argnames=(
         "k", "n", "integral", "use_mst", "node_ascent", "reorder_every",
-        "mst_kernel",
+        "mst_kernel", "push_order",
     ),
 )
 def _solve_device(
@@ -1225,6 +1248,7 @@ def _solve_device(
     node_ascent: int = 0,
     reorder_every: int = 0,
     mst_kernel: str = "prim",
+    push_order: str = "best-first",
 ):
     """Run the ENTIRE search (up to ``max_steps`` expansion steps) in one
     device dispatch, with on-device stack compaction under capacity
@@ -1247,7 +1271,7 @@ def _solve_device(
     return _guarded_expand_steps(
         fr, inc_cost, inc_tour, d, min_out, bound_adj, dbar, pi, mst_slack,
         ascent_step, lam_budget, max_steps, k, n, integral, use_mst,
-        node_ascent, reorder_every, step0, mst_kernel
+        node_ascent, reorder_every, step0, mst_kernel, push_order
     )
 
 
@@ -1255,6 +1279,7 @@ def _guarded_expand_steps(
     fr, inc_cost, inc_tour, d, min_out, bound_adj, dbar, pi, mst_slack,
     ascent_step, lam_budget, max_steps, k, n, integral, use_mst, node_ascent,
     reorder_every: int = 0, step0=0, mst_kernel: str = "prim",
+    push_order: str = "best-first",
 ):
     """Up to ``max_steps`` expansion steps with a PER-STEP capacity guard:
     compact under pressure, and if compaction cannot get below the
@@ -1315,7 +1340,7 @@ def _guarded_expand_steps(
             fr, ic, itour, stats = _expand_step(
                 fr, ic, itour, d, min_out, bound_adj, dbar, pi, mst_slack,
                 ascent_step, lam_budget, k, n, integral, use_mst,
-                node_ascent, mst_kernel
+                node_ascent, mst_kernel, push_order
             )
             return fr, ic, itour, stats["popped"]
 
@@ -1555,6 +1580,7 @@ def warm_compile_device_solver(
     node_ascent: int = 2,
     reorder_every: int = 0,
     mst_kernel: str = "prim",
+    push_order: str = "best-first",
 ) -> None:
     """AOT-compile ``_solve_device`` for the given static shapes WITHOUT
     executing anything on the device.
@@ -1576,7 +1602,7 @@ def warm_compile_device_solver(
         fr, sd((), f32), sd((n + 1,), i32), sd((n, n), f32), sd((n,), f32),
         sd((n,), f32), sd((n, n), f32), sd((n,), f32), sd((), f32),
         sd((), f32), sd((), f32), sd((), i32), sd((), i32), k, n, integral,
-        mst_prune, node_ascent, reorder_every, mst_kernel
+        mst_prune, node_ascent, reorder_every, mst_kernel, push_order
     ).compile()
 
 
@@ -1599,8 +1625,14 @@ def solve(
     ascent: str = "host",
     reorder_every: int = 0,
     mst_kernel: str = "prim",
+    push_order: str = "best-first",
 ) -> BnBResult:
     """Exact B&B on one device. ``d`` is a dense [n, n] distance matrix.
+
+    ``push_order``: "best-first" (default — two-level sort keeps the
+    stack top on the best child) or "natural" (no per-step sort: cheaper
+    steps but a possibly larger tree when the incumbent improves
+    mid-search; always certifies the same optimum).
 
     ``mst_kernel``: "prim" (sequential [k, n] chain — the default on
     every backend) or "boruvka" (log-depth batched variant built for the
@@ -1737,7 +1769,7 @@ def solve(
                 bd.pi, bd.slack, bd.ascent_step, bd.lam_budget,
                 jnp.asarray(budget, jnp.int32), jnp.asarray(it, jnp.int32),
                 k, n, integral, mst_prune, node_ascent, reorder_every,
-                mst_kernel
+                mst_kernel, push_order
             )
             # first readback of the run — everything before this line ran
             # in the relay's fast mode
@@ -1766,7 +1798,7 @@ def solve(
             fr, inc_cost, inc_tour, popped = _expand_loop(
                 fr, inc_cost, inc_tour, d32, min_out, bound_adj, bd.dbar,
                 bd.pi, bd.slack, bd.ascent_step, bd.lam_budget, k, n, inner,
-                integral, mst_prune, node_ascent, mst_kernel
+                integral, mst_prune, node_ascent, mst_kernel, push_order
             )
             nodes += int(popped)
             it += inner
@@ -1874,6 +1906,7 @@ def solve_sharded(
     reorder_every: int = 0,
     mst_kernel: str = "prim",
     balance: str = "pair",
+    push_order: str = "best-first",
 ) -> BnBResult:
     """Mesh-parallel B&B: per-rank frontiers, collective incumbent sharing.
 
@@ -2089,7 +2122,7 @@ def solve_sharded(
         f2, c2, t2, nodes = _expand_loop(
             local, ic_l[0], itour_l[0], d_rep, mo_rep, ba_rep, dbar_rep,
             pi_rep, slack_rep, step_rep, budget_rep, k, n, inner_steps,
-            integral, mst_prune, node_ascent, mst_kernel
+            integral, mst_prune, node_ascent, mst_kernel, push_order
         )
         if num_ranks > 1:
             f2 = balance_fn(f2, it_rep)
@@ -2183,6 +2216,7 @@ def solve_sharded(
                 reorder_every=reorder_every,
                 step0=it0_rep + i * inner_steps,
                 mst_kernel=mst_kernel,
+                push_order=push_order,
             )
             if num_ranks > 1:
                 # round_i counts BALANCE EVENTS, not steps: step counts
