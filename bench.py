@@ -133,6 +133,8 @@ def bench_bnb() -> int:
     # eil51 the ILS start is not optimal, so pop order does shape the
     # tree; BENCH_BNB_TPU_R5_NOSORT.json is the on-chip A/B verdict)
     po = os.environ.get("TSP_BENCH_PUSH_ORDER", "best-first")
+    # capped push-block rows (0 = full k*n; scatter_profile v4 sizes it)
+    pb = int(os.environ.get("TSP_BENCH_PUSH_BLOCK", "0"))
     if mk not in bb._MST_CONN:
         print(
             f"bench: TSP_BENCH_MST_KERNEL={mk!r} is not one of "
@@ -146,21 +148,21 @@ def bench_bnb() -> int:
         # kernels; the fine-grained host loop also honors time_limit_s
         bb.solve(d, capacity=capacity, k=k, node_ascent=na,
                  device_loop=False, max_iters=8, mst_kernel=mk,
-                 push_order=po)
+                 push_order=po, push_block=pb)
     else:
         # AOT compile only (no device execution -> the relay stays in fast
         # mode); integral must match what _bound_setup will derive from
         # the data or the timed dispatch recompiles a new static config
         bb.warm_compile_device_solver(
             n, capacity, k, bb._is_integral(d), True, na, mst_kernel=mk,
-            push_order=po,
+            push_order=po, push_block=pb,
         )
     print(f"warmup (compile): {time.perf_counter() - t0:.1f}s", file=sys.stderr)
 
     res = bb.solve(
         d, capacity=capacity, k=k, time_limit_s=600, node_ascent=na,
         device_loop=not on_cpu, max_iters=5_000_000, mst_kernel=mk,
-        push_order=po,
+        push_order=po, push_block=pb,
     )
     ok = res.proven_optimal and res.cost == inst.known_optimum
     print(
@@ -198,6 +200,7 @@ def bench_bnb() -> int:
                 "setup_ils_s": round(res.ils_seconds, 2),
                 "mst_kernel": mk,
                 "push_order": po,
+                "push_block": pb,
                 "anchor": (
                     "this engine's own 1-rank CPU rate x8 "
                     "(assumes perfect 8-way MPI scaling)"

@@ -233,6 +233,36 @@ def test_natural_push_order_same_proof():
         assert nat_dev.proven_optimal and nat_dev.cost == base.cost
 
 
+def test_capped_push_block_same_proof():
+    """push_block caps the per-step block write with a lax.cond full-block
+    fallback — the proof and trajectory must be IDENTICAL to the uncapped
+    engine (both branches write every pushed row; the cap only trims
+    garbage rows), on the host loop and the device loop, including caps
+    small enough that the fallback branch actually runs."""
+    d = np.rint(random_d(13, 5) * 10)
+    base = bb.solve(d, capacity=1 << 14, k=64, push_order="natural")
+    for pb in (64, 256):  # 64 << typical n_push: fallback branch exercised
+        capped = bb.solve(d, capacity=1 << 14, k=64, push_order="natural",
+                          push_block=pb)
+        assert capped.proven_optimal and capped.cost == base.cost
+        # identical trajectory: the cap is write-shape-only
+        assert capped.nodes_expanded == base.nodes_expanded
+    # device loop: trajectory identity too (a capped-write bug confined to
+    # _guarded_expand_steps' consumers would slip past a cost-only check)
+    dev_base = bb.solve(d, capacity=1 << 14, k=64, push_order="natural",
+                        device_loop=True)
+    dev = bb.solve(d, capacity=1 << 14, k=64, push_order="natural",
+                   push_block=256, device_loop=True)
+    assert dev.proven_optimal and dev.cost == base.cost
+    assert dev.nodes_expanded == dev_base.nodes_expanded
+    # sharded plumbing: the capped engine under shard_map + balance
+    sh = bb.solve_sharded(d, make_rank_mesh(4), capacity_per_rank=1 << 12,
+                          k=16, push_block=128)
+    assert sh.proven_optimal and sh.cost == base.cost
+    with pytest.raises(ValueError, match="push_block"):
+        bb.solve(d, capacity=1 << 14, k=64, push_block=-100, max_iters=4)
+
+
 def test_pair_assignment_rotation_starves_nobody():
     """The pair-balance matching must not deterministically starve a rank.
 

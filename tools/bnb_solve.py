@@ -68,6 +68,12 @@ def main() -> int:
         "certified optimum either way)",
     )
     ap.add_argument(
+        "--push-block", type=int, default=0,
+        help="cap the per-step push block write at this many rows "
+        "(lax.cond full-block fallback keeps exactness; 0 = always the "
+        "full k*n block)",
+    )
+    ap.add_argument(
         "--balance", default="pair", choices=["pair", "ring"],
         help="sharded load-balance scheme: pair (richest donates to "
         "poorest each round — O(1) flattening) or ring (successor "
@@ -151,6 +157,7 @@ def main() -> int:
             mst_kernel=args.mst_kernel,
             balance=args.balance,
             push_order=args.push_order,
+            push_block=args.push_block,
         )
     else:
         res = bb.solve(
@@ -169,6 +176,7 @@ def main() -> int:
             reorder_every=args.reorder_every,
             mst_kernel=args.mst_kernel,
             push_order=args.push_order,
+            push_block=args.push_block,
         )
 
     opt = inst.known_optimum
@@ -207,6 +215,7 @@ def main() -> int:
                 "bound": args.bound,
                 "mst_kernel": args.mst_kernel,
                 "push_order": args.push_order,
+                "push_block": args.push_block,
                 "balance": args.balance if args.ranks > 1 else None,
                 "root_lower_bound": round(res.root_lower_bound, 3),
                 # final certified LB (min over still-open nodes; = cost when

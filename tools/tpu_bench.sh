@@ -52,6 +52,13 @@ if [ ! -s BENCH_BNB_TPU_R5_NOSORT.json ]; then
     [ -s BENCH_BNB_TPU_R5_NOSORT.json ] || rm -f BENCH_BNB_TPU_R5_NOSORT.json
 fi
 
+if [ ! -s BENCH_BNB_TPU_R5_CAPPED.json ]; then
+    echo "== r5 B&B eil51, capped push block (scatter v4, engine A/B) =="
+    TSP_BENCH=bnb TSP_BENCH_PUSH_BLOCK=4096 python bench.py \
+        2> >(tail -3 >&2) | tee BENCH_BNB_TPU_R5_CAPPED.json
+    [ -s BENCH_BNB_TPU_R5_CAPPED.json ] || rm -f BENCH_BNB_TPU_R5_CAPPED.json
+fi
+
 if [ "$(wc -l < BENCH_BNB_TPU_KSWEEP_R5.jsonl 2>/dev/null || echo 0)" -lt 4 ]; then
     echo "== r5 B&B eil51 k-sweep =="
     : > BENCH_BNB_TPU_KSWEEP_R5.tmp

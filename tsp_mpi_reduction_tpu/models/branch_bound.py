@@ -862,7 +862,7 @@ def _batched_mst_bound(
     jax.jit,
     static_argnames=(
         "k", "n", "integral", "use_mst", "node_ascent", "mst_kernel",
-        "push_order",
+        "push_order", "push_block",
     ),
 )
 def _expand_step(
@@ -884,6 +884,7 @@ def _expand_step(
     node_ascent: int = 0,
     mst_kernel: str = "prim",
     push_order: str = "best-first",
+    push_block: int = 0,
 ):
     """Pop <=K nodes, expand, prune, push. Returns (frontier', inc', stats).
 
@@ -918,6 +919,11 @@ def _expand_step(
         raise ValueError(
             f"unknown push_order {push_order!r} (expected best-first|natural)"
         )
+    if push_block < 0:
+        # a negative cap would silently behave as uncapped (the cond
+        # predicate never fires) while compiling a mis-shaped dead branch
+        # and mislabeling the A/B artifact
+        raise ValueError(f"push_block must be >= 0, got {push_block}")
     f_cap = f_phys - k * n  # logical capacity
     w = (n + 31) // 32
     lanes = jnp.arange(k, dtype=jnp.int32)
@@ -1075,16 +1081,35 @@ def _expand_step(
     comp_idx = jnp.zeros(kn, jnp.int32).at[
         jnp.where(flat_push, rank, kn)
     ].set(jnp.arange(kn, dtype=jnp.int32), mode="drop")
-    block = cand[comp_idx]
-    # while the count<=f_cap invariant holds, base+kn <= f_phys and the
-    # clamp is a no-op; if a caller breaks it (e.g. resuming a checkpoint
-    # with a larger k), the clamped write overlaps live rows — flag it so
-    # exactness loss is never silent (same honesty as scatter-drop was)
-    start = jnp.minimum(base, f_phys - kn)
-    # literal 0 would trace as int64 under x64 mode; match start's dtype
-    new_nodes = jax.lax.dynamic_update_slice(
-        fr.nodes, block, (start, jnp.zeros((), start.dtype))
-    )
+
+    def _block_write(nodes, rows: int):
+        # while the count<=f_cap invariant holds, base+rows <= f_phys and
+        # the clamp is a no-op; if a caller breaks it (e.g. resuming a
+        # checkpoint with a larger k), the clamped write overlaps live
+        # rows — flagged below so exactness loss is never silent (same
+        # honesty as scatter-drop was)
+        block = cand[comp_idx[:rows]]
+        start = jnp.minimum(base, f_phys - rows)
+        # literal 0 would trace as int64 under x64 mode; match start dtype
+        return jax.lax.dynamic_update_slice(
+            nodes, block, (start, jnp.zeros((), start.dtype))
+        )
+
+    if push_block and push_block < kn:
+        # capped block write (scatter_profile v4): typical steps push ~k
+        # rows, so gathering/writing the full k*n block materializes ~92%
+        # garbage; cap the common case at push_block rows and lax.cond to
+        # the full block on the (counted-rare) steps where n_push exceeds
+        # it — both branches write every pushed row, so exactness is
+        # unconditional
+        new_nodes = jax.lax.cond(
+            n_push <= push_block,
+            lambda nodes: _block_write(nodes, push_block),
+            lambda nodes: _block_write(nodes, kn),
+            fr.nodes,
+        )
+    else:
+        new_nodes = _block_write(fr.nodes, kn)
 
     new_count = base + n_push.astype(jnp.int32)
     overflow = fr.overflow | (new_count > f_cap) | (base > f_phys - kn)
@@ -1103,7 +1128,7 @@ def _expand_step(
     jax.jit,
     static_argnames=(
         "k", "n", "inner_steps", "integral", "use_mst", "node_ascent",
-        "mst_kernel", "push_order",
+        "mst_kernel", "push_order", "push_block",
     ),
 )
 def _expand_loop(
@@ -1126,6 +1151,7 @@ def _expand_loop(
     node_ascent: int = 0,
     mst_kernel: str = "prim",
     push_order: str = "best-first",
+    push_block: int = 0,
 ):
     """Run up to ``inner_steps`` expansion steps in ONE device program.
 
@@ -1142,7 +1168,7 @@ def _expand_loop(
         fr, ic, itour, stats = _expand_step(
             fr, ic, itour, d, min_out, bound_adj, dbar, pi, mst_slack,
             ascent_step, lam_budget, k, n, integral, use_mst, node_ascent,
-            mst_kernel, push_order
+            mst_kernel, push_order, push_block
         )
         return fr, ic, itour, nodes + stats["popped"], i + 1
 
@@ -1224,7 +1250,7 @@ def _compact_frontier(fr: Frontier, inc_cost, integral: bool, rows=None) -> Fron
     jax.jit,
     static_argnames=(
         "k", "n", "integral", "use_mst", "node_ascent", "reorder_every",
-        "mst_kernel", "push_order",
+        "mst_kernel", "push_order", "push_block",
     ),
 )
 def _solve_device(
@@ -1249,6 +1275,7 @@ def _solve_device(
     reorder_every: int = 0,
     mst_kernel: str = "prim",
     push_order: str = "best-first",
+    push_block: int = 0,
 ):
     """Run the ENTIRE search (up to ``max_steps`` expansion steps) in one
     device dispatch, with on-device stack compaction under capacity
@@ -1271,7 +1298,7 @@ def _solve_device(
     return _guarded_expand_steps(
         fr, inc_cost, inc_tour, d, min_out, bound_adj, dbar, pi, mst_slack,
         ascent_step, lam_budget, max_steps, k, n, integral, use_mst,
-        node_ascent, reorder_every, step0, mst_kernel, push_order
+        node_ascent, reorder_every, step0, mst_kernel, push_order, push_block
     )
 
 
@@ -1279,7 +1306,7 @@ def _guarded_expand_steps(
     fr, inc_cost, inc_tour, d, min_out, bound_adj, dbar, pi, mst_slack,
     ascent_step, lam_budget, max_steps, k, n, integral, use_mst, node_ascent,
     reorder_every: int = 0, step0=0, mst_kernel: str = "prim",
-    push_order: str = "best-first",
+    push_order: str = "best-first", push_block: int = 0,
 ):
     """Up to ``max_steps`` expansion steps with a PER-STEP capacity guard:
     compact under pressure, and if compaction cannot get below the
@@ -1340,7 +1367,7 @@ def _guarded_expand_steps(
             fr, ic, itour, stats = _expand_step(
                 fr, ic, itour, d, min_out, bound_adj, dbar, pi, mst_slack,
                 ascent_step, lam_budget, k, n, integral, use_mst,
-                node_ascent, mst_kernel, push_order
+                node_ascent, mst_kernel, push_order, push_block
             )
             return fr, ic, itour, stats["popped"]
 
@@ -1581,6 +1608,7 @@ def warm_compile_device_solver(
     reorder_every: int = 0,
     mst_kernel: str = "prim",
     push_order: str = "best-first",
+    push_block: int = 0,
 ) -> None:
     """AOT-compile ``_solve_device`` for the given static shapes WITHOUT
     executing anything on the device.
@@ -1602,7 +1630,8 @@ def warm_compile_device_solver(
         fr, sd((), f32), sd((n + 1,), i32), sd((n, n), f32), sd((n,), f32),
         sd((n,), f32), sd((n, n), f32), sd((n,), f32), sd((), f32),
         sd((), f32), sd((), f32), sd((), i32), sd((), i32), k, n, integral,
-        mst_prune, node_ascent, reorder_every, mst_kernel, push_order
+        mst_prune, node_ascent, reorder_every, mst_kernel, push_order,
+        push_block
     ).compile()
 
 
@@ -1626,6 +1655,7 @@ def solve(
     reorder_every: int = 0,
     mst_kernel: str = "prim",
     push_order: str = "best-first",
+    push_block: int = 0,
 ) -> BnBResult:
     """Exact B&B on one device. ``d`` is a dense [n, n] distance matrix.
 
@@ -1633,6 +1663,11 @@ def solve(
     stack top on the best child) or "natural" (no per-step sort: cheaper
     steps but a possibly larger tree when the incumbent improves
     mid-search; always certifies the same optimum).
+
+    ``push_block``: cap the per-step push block write at this many rows,
+    lax.cond-falling back to the full k*n block on steps that push more
+    (exactness unconditional). 0 (default) = always the full block; the
+    scatter_profile v4 experiment sizes the win before adoption.
 
     ``mst_kernel``: "prim" (sequential [k, n] chain — the default on
     every backend) or "boruvka" (log-depth batched variant built for the
@@ -1769,7 +1804,7 @@ def solve(
                 bd.pi, bd.slack, bd.ascent_step, bd.lam_budget,
                 jnp.asarray(budget, jnp.int32), jnp.asarray(it, jnp.int32),
                 k, n, integral, mst_prune, node_ascent, reorder_every,
-                mst_kernel, push_order
+                mst_kernel, push_order, push_block
             )
             # first readback of the run — everything before this line ran
             # in the relay's fast mode
@@ -1798,7 +1833,8 @@ def solve(
             fr, inc_cost, inc_tour, popped = _expand_loop(
                 fr, inc_cost, inc_tour, d32, min_out, bound_adj, bd.dbar,
                 bd.pi, bd.slack, bd.ascent_step, bd.lam_budget, k, n, inner,
-                integral, mst_prune, node_ascent, mst_kernel, push_order
+                integral, mst_prune, node_ascent, mst_kernel, push_order,
+                push_block
             )
             nodes += int(popped)
             it += inner
@@ -1907,6 +1943,7 @@ def solve_sharded(
     mst_kernel: str = "prim",
     balance: str = "pair",
     push_order: str = "best-first",
+    push_block: int = 0,
 ) -> BnBResult:
     """Mesh-parallel B&B: per-rank frontiers, collective incumbent sharing.
 
@@ -2122,7 +2159,8 @@ def solve_sharded(
         f2, c2, t2, nodes = _expand_loop(
             local, ic_l[0], itour_l[0], d_rep, mo_rep, ba_rep, dbar_rep,
             pi_rep, slack_rep, step_rep, budget_rep, k, n, inner_steps,
-            integral, mst_prune, node_ascent, mst_kernel, push_order
+            integral, mst_prune, node_ascent, mst_kernel, push_order,
+            push_block
         )
         if num_ranks > 1:
             f2 = balance_fn(f2, it_rep)
@@ -2217,6 +2255,7 @@ def solve_sharded(
                 step0=it0_rep + i * inner_steps,
                 mst_kernel=mst_kernel,
                 push_order=push_order,
+                push_block=push_block,
             )
             if num_ranks > 1:
                 # round_i counts BALANCE EVENTS, not steps: step counts
