@@ -59,6 +59,22 @@ if [ ! -s BENCH_BNB_TPU_R5_CAPPED.json ]; then
     [ -s BENCH_BNB_TPU_R5_CAPPED.json ] || rm -f BENCH_BNB_TPU_R5_CAPPED.json
 fi
 
+if [ ! -s BENCH_BNB_TPU_R5_COMBO.json ]; then
+    # best-guess combined config: k=256 won the r4 k-sweep (199k vs
+    # 172.5k at k=1024) and the capped block is the biggest single-step
+    # saving candidate. The cap scales with k (T = 4*k rows: 1024 here,
+    # mirroring the CAPPED leg's 4096 at k=1024 and scatter_profile's
+    # cap_T = min(4k, kn)), so the combo differs from CAPPED in k only
+    # modulo that scaling; the pure k effect is isolated by the KSWEEP
+    # leg and the pure cap effect by CAPPED vs the plain R5 leg.
+    # Captured so an unattended grant records the likely-best config
+    # even before any interactive tuning session.
+    echo "== r5 B&B eil51, combo (k=256 + capped push block) =="
+    TSP_BENCH=bnb TSP_BENCH_K=256 TSP_BENCH_PUSH_BLOCK=1024 python bench.py \
+        2> >(tail -3 >&2) | tee BENCH_BNB_TPU_R5_COMBO.json
+    [ -s BENCH_BNB_TPU_R5_COMBO.json ] || rm -f BENCH_BNB_TPU_R5_COMBO.json
+fi
+
 if [ "$(wc -l < BENCH_BNB_TPU_KSWEEP_R5.jsonl 2>/dev/null || echo 0)" -lt 4 ]; then
     echo "== r5 B&B eil51 k-sweep =="
     : > BENCH_BNB_TPU_KSWEEP_R5.tmp
