@@ -233,6 +233,38 @@ def test_natural_push_order_same_proof():
         assert nat_dev.proven_optimal and nat_dev.cost == base.cost
 
 
+def test_reservoir_exchange_repartitions_globally():
+    """The r5 kroA100 campaign measured a DFS-with-spill inversion: the
+    reservoir held 2.65M nodes BETTER than the frontier's best, pinning
+    the certified LB while the device expanded worse subtrees. exchange()
+    must re-partition globally: best bounds on-device (best on top),
+    worst spilled, incumbent-closed nodes dropped."""
+    import jax.numpy as jnp
+
+    n = 6
+    def rows(bounds):
+        m = len(bounds)
+        return bb._pack_rows_np(
+            np.zeros((m, n), np.int32), np.zeros((m, 1), np.uint32),
+            np.full(m, 2, np.int32), np.zeros(m, np.float32),
+            np.asarray(bounds, np.float32), np.zeros(m, np.float32),
+        )
+
+    fr_rows = np.zeros((10, n + 1 + 4), np.int32)
+    fr_rows[:4] = rows([50.0, 40.0, 30.0, 99.0])  # 99: incumbent-closed
+    fr = bb.Frontier(jnp.asarray(fr_rows), jnp.asarray(4, jnp.int32),
+                     jnp.asarray(False))
+    rv = bb._Reservoir()
+    rv.chunks.append(rows([5.0, 7.0, 6.0]))
+    out = rv.exchange(fr, inc_cost=90.0, integral=False, capacity=8)
+    assert int(out.count) == 4  # min(6 alive, capacity//2=4)
+    got = bb._np_bound_col(np.asarray(out.nodes[:4]))
+    # stack order: worst at bottom, best on top (popped first)
+    assert got.tolist() == [30.0, 7.0, 6.0, 5.0]
+    assert len(rv) == 2 and rv.min_bound() == 40.0  # spilled remainder
+    # nothing lost: 4 on device + 2 spilled = 6 alive (99 dropped by inc)
+
+
 def test_capped_push_block_same_proof():
     """push_block caps the per-step block write with a lax.cond full-block
     fallback — the proof and trajectory must be IDENTICAL to the uncapped

@@ -1419,23 +1419,6 @@ class _Reservoir:
         ]
         return min(mins) if mins else float("inf")
 
-    def spill(self, fr: Frontier, keep: int) -> Frontier:
-        """Move all but the top ``keep`` stack entries to the host."""
-        cnt = int(fr.count)
-        cut = max(cnt - keep, 0)
-        if cut == 0:
-            return fr
-        # one device->host transfer of the live row prefix; entries at or
-        # above the new count are dead (pushes overwrite before any read),
-        # so only the kept slice needs to go back up
-        rows = np.asarray(fr.nodes[:cnt])
-        self.chunks.append(rows[:cut].copy())
-        return Frontier(
-            fr.nodes.at[: cnt - cut].set(rows[cut:cnt]),
-            jnp.asarray(cnt - cut, jnp.int32),
-            fr.overflow,
-        )
-
     def refill(
         self, fr: Frontier, inc_cost: float, integral: bool, capacity: int
     ) -> Frontier:
@@ -1452,41 +1435,101 @@ class _Reservoir:
             jnp.asarray(host), jnp.asarray(take, jnp.int32), fr.overflow
         )
 
-    def spill_host(self, host: np.ndarray, count: int, keep: int) -> int:
-        """In-place numpy variant of ``spill`` (sharded path: the frontier
-        is already a host copy). Returns the new count."""
-        cut = max(count - keep, 0)
-        if cut == 0:
-            return count
-        self.chunks.append(host[:cut].copy())
-        host[: count - cut] = host[cut:count]
-        return count - cut
-
-    def refill_host(self, host: np.ndarray, capacity: int, inc_cost, integral) -> int:
-        """In-place numpy variant of ``refill``; host rows must be empty
-        (count 0). Returns the new count."""
-        merged = np.concatenate(self.chunks)
+    def _partition(self, extra, inc_cost, integral, capacity: int):
+        """Shared core of exchange/refill: merge ``extra`` rows (may be
+        None) with every spilled chunk, drop incumbent-closed nodes, keep
+        the best-bound ``min(alive, capacity // 2)`` rows (returned in
+        stack order, worst at the bottom) and re-spill the remainder.
+        Selection uses argpartition (O(R)), sorting only the kept rows."""
+        chunks = self.chunks if extra is None else self.chunks + [extra]
         self.chunks = []
+        chunks = [c for c in chunks if c.shape[0]]
+        if not chunks:
+            return None
+        merged = chunks[0] if len(chunks) == 1 else np.concatenate(chunks)
         bounds = _np_bound_col(merged)
-        alive = (
-            bounds <= inc_cost - 1.0 if integral else bounds < inc_cost
-        )
+        alive = bounds <= inc_cost - 1.0 if integral else bounds < inc_cost
         merged = merged[alive]
         bounds = bounds[alive]
         m = merged.shape[0]
         take = min(m, capacity // 2)
-        if take < m:
-            # reload the BEST-bound nodes first; the rest stays spilled
-            order = np.argsort(bounds, kind="stable")
-            self.chunks.append(merged[order[take:]])
-            merged = merged[order[:take]]
-            bounds = bounds[order[:take]]
         if take == 0:
-            return 0
-        # stack order: worst bound at the bottom, best on top (pop side)
+            return None
+        if take < m:
+            sel = np.argpartition(bounds, take - 1)[:take]
+            rest = np.ones(m, bool)
+            rest[sel] = False
+            self.chunks.append(merged[rest])
+            merged = merged[sel]
+            bounds = bounds[sel]
+        # stack order: worst bound at the bottom, best on top
         order = np.argsort(-bounds, kind="stable")
-        host[:take] = merged[order]
-        return take
+        return merged[order]
+
+    def exchange(
+        self, fr: Frontier, inc_cost: float, integral: bool, capacity: int
+    ) -> Frontier:
+        """Globally re-partition ALL open nodes (device stack + reservoir):
+        the best-bound ``capacity // 2`` go back on-device (best on top),
+        the rest spill. Also drops nodes the incumbent has since closed.
+
+        This fixes the DFS-with-spill inversion the round-5 kroA100
+        campaign measured: nodes spilled early (shallow, low bound) end up
+        BETTER than the device's current deep working set — at the
+        flattening point the reservoir's min bound was 21128.4 vs the
+        frontier's 21212.5, with 2.65M reservoir nodes better than the
+        frontier's best, so the certified LB sat pinned in the reservoir
+        for four straight chunks while the device expanded worse subtrees
+        (plain ``refill`` only fires on a DRAINED frontier, which never
+        came). Paid only at spill/refill/resume events, which already
+        fetch the device buffer; when no inversion exists (every reservoir
+        node at least as bad as every live node), the merge degenerates to
+        the old keep-best-half spill at the same cost class.
+        """
+        cnt = int(fr.count)
+        host = np.asarray(fr.nodes).copy()
+        live = host[:cnt].copy()
+        if cnt and self.min_bound() >= float(_np_bound_col(live).max()):
+            # no inversion: every spilled node is at least as bad as the
+            # worst live node — partition the live rows alone (O(cnt)),
+            # leaving the reservoir untouched
+            keep = self._keep_live_only(live, inc_cost, integral, capacity)
+        else:
+            keep = self._partition(live, inc_cost, integral, capacity)
+        take = 0 if keep is None else keep.shape[0]
+        if take:
+            host[:take] = keep
+        return Frontier(
+            jnp.asarray(host), jnp.asarray(take, jnp.int32), fr.overflow
+        )
+
+    def _keep_live_only(self, live, inc_cost, integral, capacity: int):
+        """exchange()'s no-inversion fast path: best-half select over the
+        live rows only; the cut rows join the reservoir."""
+        saved, self.chunks = self.chunks, []
+        keep = self._partition(live, inc_cost, integral, capacity)
+        saved.extend(self.chunks)  # the cut remainder
+        self.chunks = saved
+        return keep
+
+    def exchange_host(
+        self, host: np.ndarray, count: int, inc_cost, integral,
+        capacity: int,
+    ) -> int:
+        """In-place numpy variant of ``exchange`` (sharded path: the
+        frontier is already a host copy). Returns the new count."""
+        keep = self._partition(
+            host[:count].copy(), inc_cost, integral, capacity
+        )
+        if keep is None:
+            return 0
+        host[: keep.shape[0]] = keep
+        return keep.shape[0]
+
+    def refill_host(self, host: np.ndarray, capacity: int, inc_cost, integral) -> int:
+        """In-place numpy variant of ``refill``; host rows must be empty
+        (count 0). Returns the new count."""
+        return self.exchange_host(host, 0, inc_cost, integral, capacity)
 
 
 def make_root_frontier(
@@ -1745,15 +1788,19 @@ def solve(
         # caller's argument must not disarm the spill trigger below (and
         # the device_loop guard must re-check against THIS capacity)
         capacity = max(int(fr.nodes.shape[0]) - k * n, 1)
-        if int(fr.count) > capacity - _spill_headroom(
+        if len(reservoir) or int(fr.count) > capacity - _spill_headroom(
             capacity, inner_steps, k, n
         ):
-            # checkpoint written with a smaller k (or pre-padding layout):
-            # a restored count inside the spill band would let the FIRST
-            # (unguarded, host-loop) batch overflow the logical capacity
-            # and trip the sticky exactness-lost flag — shed to the
-            # reservoir before any dispatch instead
-            fr = reservoir.spill(fr, keep=capacity // 2)
+            # (a) a non-empty reservoir may hold the globally best open
+            # nodes (the spill-inversion measured by the r5 campaign —
+            # see _Reservoir.exchange), so every resumed chunk starts
+            # from a global best-half re-partition; (b) a checkpoint
+            # written with a smaller k (or pre-padding layout) can
+            # restore a count inside the spill band, which would let the
+            # FIRST (unguarded, host-loop) batch overflow the logical
+            # capacity and trip the sticky exactness-lost flag — the
+            # exchange's take <= capacity//2 sheds that overhang too
+            fr = reservoir.exchange(fr, float(inc_cost), integral, capacity)
         device_loop = _resolve_device_loop(
             device_loop, auto_device_loop, capacity, k, n,
             source=f" from checkpoint {resume_from!r}",
@@ -1847,7 +1894,12 @@ def solve(
             fr = reservoir.refill(fr, ic, integral, capacity=capacity)
             cnt = int(fr.count)
         elif cnt > capacity - headroom:
-            fr = reservoir.spill(fr, keep=capacity // 2)
+            # exchange, not plain spill: the same host fetch the spill
+            # pays, plus a global best-half re-partition with the
+            # reservoir, so spilled-early low-bound nodes can't pin the
+            # certified LB while the device expands worse subtrees
+            fr = reservoir.exchange(fr, ic, integral, capacity)
+            cnt = int(fr.count)
         if (
             reorder_every
             and not device_loop
@@ -2348,13 +2400,14 @@ def solve_sharded(
         host = np.asarray(fr.nodes).copy()
         new_counts = counts.copy()
         for r in range(num_ranks):
-            if spilling[r]:
-                new_counts[r] = reservoirs[r].spill_host(
-                    host[r], int(counts[r]), keep=capacity_per_rank // 2
-                )
-            elif refilling[r]:
-                new_counts[r] = reservoirs[r].refill_host(
-                    host[r], capacity_per_rank, inc_best, integral
+            if spilling[r] or refilling[r]:
+                # exchange, not plain spill/refill: the per-rank global
+                # best-half re-partition prevents the spill inversion
+                # (see _Reservoir.exchange) from pinning the certified LB
+                # in a rank's reservoir
+                new_counts[r] = reservoirs[r].exchange_host(
+                    host[r], int(counts[r]), inc_best, integral,
+                    capacity_per_rank,
                 )
         stacked = Frontier(
             jax.device_put(host, spec),
