@@ -264,6 +264,42 @@ def test_reservoir_exchange_repartitions_globally():
     assert len(rv) == 2 and rv.min_bound() == 40.0  # spilled remainder
     # nothing lost: 4 on device + 2 spilled = 6 alive (99 dropped by inc)
 
+    # PARTIAL inversion (reservoir min between live min and live max):
+    # the device already holds the global alive minimum, so the fast path
+    # must fire — reservoir untouched, live rows best-half selected
+    fr_rows2 = np.zeros((10, n + 1 + 4), np.int32)
+    fr_rows2[:3] = rows([30.0, 50.0, 60.0])
+    fr2 = bb.Frontier(jnp.asarray(fr_rows2), jnp.asarray(3, jnp.int32),
+                      jnp.asarray(False))
+    rv2 = bb._Reservoir()
+    rv2.chunks.append(rows([35.0, 45.0]))
+    out2 = rv2.exchange(fr2, inc_cost=90.0, integral=False, capacity=4)
+    assert int(out2.count) == 2  # capacity//2 of the live rows only
+    got2 = bb._np_bound_col(np.asarray(out2.nodes[:2]))
+    assert got2.tolist() == [50.0, 30.0]  # best live on top
+    # reservoir untouched by the fast path except the live cut joining it
+    assert len(rv2) == 3 and rv2.min_bound() == 35.0
+
+    # every live row dead (incumbent improved past them): the alive-
+    # filtered guard sees an empty live minimum and must still run the
+    # full merge so the reservoir's alive nodes come back on-device
+    fr_rows3 = np.zeros((10, n + 1 + 4), np.int32)
+    fr_rows3[:2] = rows([92.0, 95.0])  # both dead at inc=90
+    fr3 = bb.Frontier(jnp.asarray(fr_rows3), jnp.asarray(2, jnp.int32),
+                      jnp.asarray(False))
+    rv3 = bb._Reservoir()
+    rv3.chunks.append(rows([60.0]))
+    out3 = rv3.exchange(fr3, inc_cost=90.0, integral=False, capacity=4)
+    assert int(out3.count) == 1
+    assert bb._np_bound_col(np.asarray(out3.nodes[:1])).tolist() == [60.0]
+    assert len(rv3) == 0
+
+    # prune GC: dead rows leave the reservoir on incumbent improvement
+    rv4 = bb._Reservoir()
+    rv4.chunks.append(rows([10.0, 80.0, 85.0]))
+    rv4.prune(82.0, integral=False)
+    assert len(rv4) == 2 and rv4.min_bound() == 10.0
+
 
 def test_capped_push_block_same_proof():
     """push_block caps the per-step block write with a lax.cond full-block

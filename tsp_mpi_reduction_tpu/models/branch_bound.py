@@ -1419,6 +1419,21 @@ class _Reservoir:
         ]
         return min(mins) if mins else float("inf")
 
+    def prune(self, inc_cost: float, integral: bool) -> None:
+        """Drop incumbent-closed rows chunk-by-chunk (O(R) scan, no
+        concatenate). Called when the incumbent improves: the exchange
+        fast path partitions live rows only, so without this the
+        reservoir would retain dead rows until the next full merge."""
+        out = []
+        for c in self.chunks:
+            b = _np_bound_col(c)
+            alive = b <= inc_cost - 1.0 if integral else b < inc_cost
+            if alive.all():
+                out.append(c)
+            elif alive.any():
+                out.append(c[alive])
+        self.chunks = out
+
     def refill(
         self, fr: Frontier, inc_cost: float, integral: bool, capacity: int
     ) -> Frontier:
@@ -1469,9 +1484,12 @@ class _Reservoir:
     def exchange(
         self, fr: Frontier, inc_cost: float, integral: bool, capacity: int
     ) -> Frontier:
-        """Globally re-partition ALL open nodes (device stack + reservoir):
-        the best-bound ``capacity // 2`` go back on-device (best on top),
-        the rest spill. Also drops nodes the incumbent has since closed.
+        """Re-partition open nodes so the certified LB can never stay
+        pinned in the reservoir: if the reservoir holds the global ALIVE
+        minimum, merge everything (device stack + every spilled chunk)
+        and put the best-bound ``capacity // 2`` back on-device (best on
+        top); otherwise keep the cheap live-rows-only best-half spill.
+        Incumbent-closed nodes are dropped from whatever is partitioned.
 
         This fixes the DFS-with-spill inversion the round-5 kroA100
         campaign measured: nodes spilled early (shallow, low bound) end up
@@ -1481,18 +1499,22 @@ class _Reservoir:
         frontier's best, so the certified LB sat pinned in the reservoir
         for four straight chunks while the device expanded worse subtrees
         (plain ``refill`` only fires on a DRAINED frontier, which never
-        came). Paid only at spill/refill/resume events, which already
-        fetch the device buffer; when no inversion exists (every reservoir
-        node at least as bad as every live node), the merge degenerates to
-        the old keep-best-half spill at the same cost class.
+        came). The full merge is paid only while the reservoir owns the
+        global minimum — an earlier any-overlap guard merged the
+        (multi-GB) reservoir on every spill and slowed chunks 2-3x.
+        In the fast-path regime reservoir nodes better than SOME live
+        nodes legitimately stay spilled; the LB lag is at most one
+        exchange period.
         """
         cnt = int(fr.count)
         host = np.asarray(fr.nodes).copy()
         live = host[:cnt].copy()
-        if cnt and self.min_bound() >= float(_np_bound_col(live).max()):
-            # no inversion: every spilled node is at least as bad as the
-            # worst live node — partition the live rows alone (O(cnt)),
-            # leaving the reservoir untouched
+        lb = _np_bound_col(live)
+        alive_lb = lb[lb <= inc_cost - 1.0] if integral else lb[lb < inc_cost]
+        live_min = float(alive_lb.min()) if alive_lb.size else float("inf")
+        # compare ALIVE minima: a dead live row below the reservoir's min
+        # must not mask a reservoir node that holds the true certified LB
+        if cnt and self.min_bound() >= live_min:
             keep = self._keep_live_only(live, inc_cost, integral, capacity)
         else:
             keep = self._partition(live, inc_cost, integral, capacity)
@@ -1504,8 +1526,9 @@ class _Reservoir:
         )
 
     def _keep_live_only(self, live, inc_cost, integral, capacity: int):
-        """exchange()'s no-inversion fast path: best-half select over the
-        live rows only; the cut rows join the reservoir."""
+        """exchange()'s fast path (global alive minimum is on-device):
+        best-half select over the live rows only; the cut rows join the
+        reservoir."""
         saved, self.chunks = self.chunks, []
         keep = self._partition(live, inc_cost, integral, capacity)
         saved.extend(self.chunks)  # the cut remainder
@@ -1793,8 +1816,8 @@ def solve(
         ):
             # (a) a non-empty reservoir may hold the globally best open
             # nodes (the spill-inversion measured by the r5 campaign —
-            # see _Reservoir.exchange), so every resumed chunk starts
-            # from a global best-half re-partition; (b) a checkpoint
+            # see _Reservoir.exchange, which merges exactly when the
+            # reservoir owns the global alive minimum); (b) a checkpoint
             # written with a smaller k (or pre-padding layout) can
             # restore a count inside the spill band, which would let the
             # FIRST (unguarded, host-loop) batch overflow the logical
@@ -1820,6 +1843,7 @@ def solve(
     setup_s = t0 - t_setup
     t_best = 0.0
     last_inc = float(inc_cost)
+    last_pruned = last_inc  # reservoir GC high-water mark
     nodes = 0
     it = 0
     inner = max(1, inner_steps)
@@ -1890,6 +1914,13 @@ def solve(
         if ic < last_inc:
             last_inc = ic
             t_best = time.perf_counter() - t0
+        if len(reservoir) and last_inc < last_pruned:
+            # GC the reservoir when the incumbent improves: the exchange
+            # fast path partitions live rows only, so dead spilled rows
+            # would otherwise persist (and weaken min_bound) until the
+            # next full merge
+            reservoir.prune(last_inc, integral)
+            last_pruned = last_inc
         if cnt == 0 and len(reservoir):
             fr = reservoir.refill(fr, ic, integral, capacity=capacity)
             cnt = int(fr.count)
@@ -2479,6 +2510,11 @@ def solve_sharded(
         if best < last_inc:
             last_inc = best
             t_best = time.perf_counter() - t0
+            # GC per-rank reservoirs against the improved incumbent (the
+            # per-rank exchange only touches ranks that spill/refill)
+            for rv in reservoirs:
+                if len(rv):
+                    rv.prune(best, integral)
         fr, total0 = spill_refill(fr, best)
         if (
             reorder_every
