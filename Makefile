@@ -14,9 +14,18 @@ PY ?= python
 CXX ?= g++
 CXXFLAGS ?= -O2 -std=c++11
 
-.PHONY: all native oracle test test-fast bench run sweep goldens clean
+.PHONY: all lint native oracle test test-fast bench run sweep goldens clean
 
-all: native oracle
+all: lint native oracle
+
+# --- static analysis: graftlint (JAX-hazard rules R1-R5, see README) plus
+# ruff when available (ruff.toml pins a minimal critical-error set; the
+# container image has no ruff, so fall back to a syntax-only compile check)
+lint:
+	$(PY) -m tsp_mpi_reduction_tpu.analysis
+	@if command -v ruff >/dev/null 2>&1; then ruff check .; \
+	else echo "lint: ruff not installed — syntax-only compile check instead"; \
+	$(PY) -m compileall -q tsp_mpi_reduction_tpu tools tests bench.py; fi
 
 # --- native C++ runtime (generator, Held-Karp, merge, pipeline) ---
 native:

@@ -655,3 +655,99 @@ def test_chunked_driver_resumes_across_processes(tmp_path):
     summary = lines[-1]
     assert summary["proven_optimal"] and summary["cost"] == 3323.0
     assert summary["chunks"] >= 2  # genuinely resumed at least once
+
+
+def _packed_rows(n, bounds):
+    """Packed frontier rows (depth 2, zero paths) with the given bounds."""
+    m = len(bounds)
+    return bb._pack_rows_np(
+        np.zeros((m, n), np.int32), np.zeros((m, 1), np.uint32),
+        np.full(m, 2, np.int32), np.zeros(m, np.float32),
+        np.asarray(bounds, np.float32), np.zeros(m, np.float32),
+    )
+
+
+def test_reservoir_take0_respills_instead_of_dropping():
+    """ADVICE r5 item 1: with capacity <= 1, capacity//2 == 0 means the
+    exchange can keep NOTHING on-device — every alive node must return to
+    the reservoir. Pre-fix, _partition cleared self.chunks, computed the
+    merged alive rows, then returned None on take==0, silently discarding
+    open nodes (a degenerate run could then claim proven_optimal with
+    subtrees unexplored)."""
+    import jax.numpy as jnp
+
+    n = 6
+    fr_rows = np.zeros((8, n + 1 + 4), np.int32)
+    fr_rows[:3] = _packed_rows(n, [10.0, 20.0, 30.0])
+    fr = bb.Frontier(jnp.asarray(fr_rows), jnp.asarray(3, jnp.int32),
+                     jnp.asarray(False))
+    rv = bb._Reservoir()
+    rv.chunks.append(_packed_rows(n, [15.0]))
+    out = rv.exchange(fr, inc_cost=90.0, integral=False, capacity=1)
+    assert int(out.count) == 0
+    # all 4 alive nodes live on in the reservoir — none dropped
+    assert len(rv) == 4 and rv.min_bound() == 10.0
+    # refill at capacity 1 also keeps them spilled rather than dropping
+    out2 = rv.refill(out, inc_cost=90.0, integral=False, capacity=1)
+    assert int(out2.count) == 0 and len(rv) == 4 and rv.min_bound() == 10.0
+    # dead rows (above the incumbent) may still be dropped legitimately
+    rv2 = bb._Reservoir()
+    rv2.chunks.append(_packed_rows(n, [95.0]))
+    empty = bb.Frontier(jnp.asarray(np.zeros((8, n + 1 + 4), np.int32)),
+                        jnp.asarray(0, jnp.int32), jnp.asarray(False))
+    out3 = rv2.exchange(empty, inc_cost=90.0, integral=False, capacity=1)
+    assert int(out3.count) == 0 and len(rv2) == 0
+
+
+def test_exchange_transfers_live_prefix_only():
+    """ADVICE r5 item 3: exchange must not round-trip the physical buffer.
+    The kept slice is written back in place — every row past ``take``
+    keeps its previous device contents bit-for-bit (the old path re-
+    uploaded the whole host copy) — and a no-keep exchange returns the
+    original buffer object outright (zero upload)."""
+    import jax.numpy as jnp
+
+    n = 6
+    fr_rows = np.zeros((12, n + 1 + 4), np.int32)
+    fr_rows[:4] = _packed_rows(n, [50.0, 40.0, 30.0, 99.0])
+    fr_rows[4:] = 7  # sentinel pattern in the dead region
+    fr = bb.Frontier(jnp.asarray(fr_rows), jnp.asarray(4, jnp.int32),
+                     jnp.asarray(False))
+    rv = bb._Reservoir()
+    rv.chunks.append(_packed_rows(n, [5.0, 7.0, 6.0]))
+    out = rv.exchange(fr, inc_cost=90.0, integral=False, capacity=8)
+    take = int(out.count)
+    assert take == 4
+    after = np.asarray(out.nodes)
+    # dead region bit-identical to the ORIGINAL device buffer: the
+    # sentinels prove no host copy of those rows was ever re-uploaded
+    assert (after[take:] == 7).all()
+    # all-dead live rows + empty reservoir: nothing to keep, and the very
+    # buffer object is reused (no upload at all)
+    rv3 = bb._Reservoir()
+    dead_rows = np.zeros((6, n + 1 + 4), np.int32)
+    dead_rows[:2] = _packed_rows(n, [95.0, 97.0])
+    dead = bb.Frontier(jnp.asarray(dead_rows), jnp.asarray(2, jnp.int32),
+                       jnp.asarray(False))
+    out3 = rv3.exchange(dead, inc_cost=90.0, integral=False, capacity=8)
+    assert int(out3.count) == 0 and out3.nodes is dead.nodes
+
+
+def test_degenerate_capacity_run_stays_honest():
+    """Degenerate-config regression for the take==0 fix: at capacity 1-2
+    (capacity//2 <= 1) the engine crawls through the reservoir one node
+    at a time — whatever it manages, a claimed proven_optimal must be the
+    true optimum (pre-fix, dropped nodes could fake the proof), and runs
+    that stop early must say so."""
+    for seed in (0, 1):
+        d = np.rint(random_d(6, seed) * 10)
+        hk, _ = solve_blocks_from_dists(d[None])
+        for cap in (1, 2):
+            res = bb.solve(d, capacity=cap, k=1, inner_steps=1,
+                           bound="min-out", mst_prune=False,
+                           max_iters=50_000, device_loop=False)
+            if res.proven_optimal:
+                assert res.cost == float(hk[0]), (seed, cap)
+            else:
+                # honest non-proof: the certified bound cannot have closed
+                assert res.lower_bound <= res.cost

@@ -1,0 +1,653 @@
+"""graftlint static analysis + runtime contract layer.
+
+Three surfaces:
+- rule fixtures: each of R1-R5 fires on its hazard snippet and stays quiet
+  on the clean rewrite (the lint must earn its exit code);
+- the meta-machinery: inline disables, hot markers, the line-free baseline;
+- the runtime layer: Frontier/PaddedTour boundary contracts and the jit
+  recompilation guard, including the guard failing a loop that re-jits a
+  fixed-shape entry point every call.
+"""
+
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tsp_mpi_reduction_tpu.analysis import contracts, graftlint
+from tsp_mpi_reduction_tpu.analysis.__main__ import main as graftlint_main
+from tsp_mpi_reduction_tpu.models import branch_bound as bb
+
+pytestmark = pytest.mark.lint  # `pytest -m lint` = fast pre-push gate
+
+
+def lint(src, **kw):
+    return graftlint.lint_text(textwrap.dedent(src), "fixture.py", **kw)
+
+
+def rules_of(violations):
+    return sorted({v.rule for v in violations})
+
+
+# -- R1: device->host pull in a hot loop -------------------------------------
+
+R1_LOOP = """
+    import numpy as np
+
+    def drain(fr, steps):
+        for _ in range(steps):
+            host = np.asarray(fr.nodes)
+        return host
+"""
+
+
+def test_r1_fires_on_pull_in_loop():
+    vs = lint(R1_LOOP)
+    assert rules_of(vs) == ["R1"] and vs[0].scope == "drain"
+
+
+def test_r1_fires_in_default_hot_path_without_lexical_loop():
+    vs = lint(
+        """
+        import numpy as np
+
+        def exchange(fr):
+            return np.asarray(fr.nodes)
+        """
+    )
+    assert rules_of(vs) == ["R1"]
+
+
+def test_r1_hot_marker_promotes_function():
+    src = """
+        import numpy as np
+
+        def fetch(fr):  # graftlint: hot
+            return np.asarray(fr.nodes)
+    """
+    assert rules_of(lint(src)) == ["R1"]
+    # same body, no marker, not a known hot path: quiet
+    assert lint(src.replace("  # graftlint: hot", "")) == []
+
+
+def test_r1_fires_on_device_copy_in_loop():
+    vs = lint(
+        """
+        import jax.numpy as jnp
+        import numpy as np
+
+        def churn(steps):
+            buf = jnp.zeros((4, 4))
+            out = []
+            while steps:
+                out.append(buf.copy())
+                steps -= 1
+            return out
+        """
+    )
+    assert "R1" in rules_of(vs)
+
+
+def test_r1_quiet_on_host_arrays():
+    assert (
+        lint(
+            """
+            import numpy as np
+
+            def fold(rows, steps):
+                acc = np.zeros(4)
+                for _ in range(steps):
+                    acc = acc + np.asarray(rows)
+                return acc
+            """
+        )
+        == []
+    )
+
+
+# -- R2: whole-buffer re-upload of a host round trip -------------------------
+
+R2_SRC = """
+    import jax.numpy as jnp
+    import numpy as np
+
+    def exchange(fr, keep, take):
+        host = np.asarray(fr.nodes)
+        host[:take] = keep
+        return jnp.asarray(host)
+"""
+
+
+def test_r2_fires_on_round_trip_reupload():
+    vs = lint(R2_SRC, rules={"R2"})
+    assert rules_of(vs) == ["R2"] and "at[:k].set" in vs[0].message
+
+
+def test_r2_quiet_outside_hot_contexts():
+    # one-time setup round trips are legitimate (e.g. _bound_setup)
+    assert (
+        lint(
+            """
+            import jax.numpy as jnp
+            import numpy as np
+
+            def setup(d):
+                d64 = np.asarray(d)
+                return jnp.asarray(d64)
+            """,
+            rules={"R2"},
+        )
+        == []
+    )
+
+
+def test_r2_quiet_on_sliced_writeback():
+    assert (
+        lint(
+            """
+            import jax.numpy as jnp
+            import numpy as np
+
+            def exchange(fr, keep, take):
+                return fr.nodes.at[:take].set(jnp.asarray(keep))
+            """,
+            rules={"R2"},
+        )
+        == []
+    )
+
+
+# -- R3: python control flow on jitted outputs --------------------------------
+
+R3_SRC = """
+    import jax
+
+    @jax.jit
+    def step(x):
+        return x * 2
+
+    def run(x):
+        y = step(x)
+        if y > 0:
+            return 1
+        while y < 3:
+            y = step(y)
+        return 0
+"""
+
+
+def test_r3_fires_on_if_and_while():
+    vs = lint(R3_SRC)
+    assert [v.rule for v in vs] == ["R3", "R3"]
+
+
+def test_r3_quiet_with_scalar_conversion():
+    assert (
+        lint(
+            """
+            import jax
+
+            @jax.jit
+            def step(x):
+                return x * 2
+
+            def run(x):
+                y = float(step(x))
+                if y > 0:
+                    return 1
+                z = step(x)
+                if int(z) > 0:
+                    return 2
+                return 0
+            """
+        )
+        == []
+    )
+
+
+def test_r3_tracks_jax_jit_assignment_and_unpack():
+    vs = lint(
+        """
+        import jax
+
+        def _kernel(x):
+            return x + 1, x - 1
+
+        kernel = jax.jit(_kernel)
+
+        def run(x):
+            hi, lo = kernel(x)
+            if hi > 0:
+                return lo
+            return hi
+        """
+    )
+    assert rules_of(vs) == ["R3"]
+
+
+# -- R4: jnp calls in a python for loop ---------------------------------------
+
+R4_SRC = """
+    import jax.numpy as jnp
+
+    def fold(xs):
+        acc = 0.0
+        for x in xs:
+            acc = acc + jnp.sum(x)
+        return acc
+"""
+
+
+def test_r4_fires_once_per_loop_anchored_on_for():
+    vs = lint(R4_SRC)
+    assert rules_of(vs) == ["R4"]
+    assert vs[0].code.startswith("for ")
+
+
+def test_r4_quiet_on_plain_python_loop():
+    assert (
+        lint(
+            """
+            def fold(xs):
+                acc = 0.0
+                for x in xs:
+                    acc += x
+                return acc
+            """
+        )
+        == []
+    )
+
+
+# -- R5: early return None drops mutated self state ---------------------------
+
+R5_SRC = """
+    class Store:
+        def flush(self, rows, cap):
+            self.chunks = []
+            merged = rows + ["extra"]
+            take = min(len(merged), cap)
+            if take == 0:
+                return None
+            self.chunks.append(merged[:take])
+            return merged
+"""
+
+
+def test_r5_fires_on_state_dropping_return():
+    vs = lint(R5_SRC)
+    assert rules_of(vs) == ["R5"] and vs[0].scope == "Store.flush"
+
+
+def test_r5_quiet_when_state_respilled():
+    # the fixed _partition shape: write back before the early return
+    assert (
+        lint(
+            """
+            class Store:
+                def flush(self, rows, cap):
+                    self.chunks = []
+                    merged = rows + ["extra"]
+                    take = min(len(merged), cap)
+                    if take == 0:
+                        self.chunks.append(merged)
+                        return None
+                    self.chunks.append(merged[:take])
+                    return merged
+            """
+        )
+        == []
+    )
+
+
+# -- escape hatches ------------------------------------------------------------
+
+def test_inline_disable_same_line_and_line_above():
+    base = R4_SRC.replace(
+        "for x in xs:", "for x in xs:  # graftlint: disable=R4"
+    )
+    assert lint(base) == []
+    above = R4_SRC.replace(
+        "        for x in xs:",
+        "        # static unroll  # graftlint: disable=R4\n        for x in xs:",
+    )
+    assert lint(above) == []
+
+
+def test_def_line_disable_covers_whole_function():
+    src = R1_LOOP.replace(
+        "def drain(fr, steps):",
+        "def drain(fr, steps):  # graftlint: disable=R1",
+    )
+    assert lint(src) == []
+
+
+def test_bare_disable_silences_all_rules():
+    src = R2_SRC.replace(
+        "return jnp.asarray(host)",
+        "return jnp.asarray(host)  # graftlint: disable",
+    )
+    assert lint(src, rules={"R2"}) == []
+
+
+def test_unrelated_disable_does_not_suppress():
+    src = R4_SRC.replace(
+        "for x in xs:", "for x in xs:  # graftlint: disable=R1"
+    )
+    assert rules_of(lint(src)) == ["R4"]
+
+
+# -- baseline ------------------------------------------------------------------
+
+def test_baseline_roundtrip_and_new_detection(tmp_path):
+    vs = lint(R4_SRC)
+    path = tmp_path / "baseline.json"
+    graftlint.write_baseline(path, vs)
+    res = graftlint.apply_baseline(vs, graftlint.load_baseline(path))
+    assert res.new == [] and len(res.accepted) == 1 and res.stale == []
+
+    # a second, different violation is NEW even with the baseline applied
+    more = vs + lint(R5_SRC)
+    res2 = graftlint.apply_baseline(more, graftlint.load_baseline(path))
+    assert [v.rule for v in res2.new] == ["R5"]
+
+
+def test_baseline_is_line_number_free(tmp_path):
+    vs = lint(R4_SRC)
+    path = tmp_path / "baseline.json"
+    graftlint.write_baseline(path, vs)
+    # shift the whole fixture down three lines: same fingerprint
+    shifted = lint("\n\n\n" + textwrap.dedent(R4_SRC))
+    assert shifted[0].line != vs[0].line
+    res = graftlint.apply_baseline(shifted, graftlint.load_baseline(path))
+    assert res.new == []
+
+
+def test_baseline_reports_stale_entries(tmp_path):
+    path = tmp_path / "baseline.json"
+    graftlint.write_baseline(path, lint(R4_SRC))
+    res = graftlint.apply_baseline([], graftlint.load_baseline(path))
+    assert len(res.stale) == 1
+
+
+# -- the CLI and the repo itself ----------------------------------------------
+
+def test_cli_nonzero_on_each_rule_fixture(tmp_path, capsys):
+    fixtures = {"R1": R1_LOOP, "R2": R2_SRC, "R3": R3_SRC, "R4": R4_SRC,
+                "R5": R5_SRC}
+    for rule, src in fixtures.items():
+        bad = tmp_path / f"bad_{rule.lower()}.py"
+        bad.write_text(textwrap.dedent(src))
+        rc = graftlint_main([str(bad), "--no-baseline"])
+        assert rc == 1, f"{rule} fixture must fail the lint"
+        assert rule in capsys.readouterr().out
+
+
+def test_cli_zero_on_clean_file(tmp_path, capsys):
+    good = tmp_path / "good.py"
+    good.write_text("import numpy as np\n\n\ndef f(x):\n    return np.sum(x)\n")
+    assert graftlint_main([str(good), "--no-baseline"]) == 0
+    capsys.readouterr()
+
+
+def test_repo_is_clean_modulo_checked_in_baseline(capsys):
+    """The regression gate: the package + tools at HEAD must lint clean
+    against the checked-in baseline (exactly what `make lint` runs)."""
+    assert graftlint_main([]) == 0
+    out = capsys.readouterr().out
+    assert "0 new" in out
+
+
+# -- runtime contracts: Frontier ----------------------------------------------
+
+def _tiny_frontier(n=6, capacity=16):
+    min_out = np.ones(n, np.float64)
+    return bb.make_root_frontier(n, capacity, min_out)
+
+
+def test_check_frontier_accepts_engine_frontier():
+    fr = _tiny_frontier()
+    assert contracts.check_frontier(fr, n=6) is fr
+
+
+def test_check_frontier_rejects_bad_dtype_and_width():
+    fr = _tiny_frontier()
+    bad_dtype = bb.Frontier(fr.nodes.astype(jnp.float32), fr.count, fr.overflow)
+    with pytest.raises(contracts.ContractError, match="int32"):
+        contracts.check_frontier(bad_dtype)
+    bad_width = bb.Frontier(fr.nodes[:, :5], fr.count, fr.overflow)
+    with pytest.raises(contracts.ContractError, match="layout"):
+        contracts.check_frontier(bad_width)
+    with pytest.raises(contracts.ContractError, match="expected n="):
+        contracts.check_frontier(fr, n=7)
+
+
+def test_check_frontier_rejects_bad_count_shape():
+    fr = _tiny_frontier()
+    bad = bb.Frontier(fr.nodes, jnp.zeros(3, jnp.int32), fr.overflow)
+    with pytest.raises(contracts.ContractError, match="count"):
+        contracts.check_frontier(bad)
+
+
+def test_check_frontier_strict_count_range(monkeypatch):
+    fr = _tiny_frontier(capacity=16)
+    over = bb.Frontier(fr.nodes, jnp.asarray(10_000, jnp.int32), fr.overflow)
+    contracts.check_frontier(over)  # metadata-only level: passes
+    monkeypatch.setenv("TSP_CONTRACTS", "strict")
+    with pytest.raises(contracts.ContractError, match="outside"):
+        contracts.check_frontier(over)
+
+
+def test_contracts_off_disables_everything(monkeypatch):
+    fr = _tiny_frontier()
+    bad = bb.Frontier(fr.nodes.astype(jnp.float32), fr.count, fr.overflow)
+    monkeypatch.setenv("TSP_CONTRACTS", "off")
+    assert contracts.check_frontier(bad) is bad
+
+
+# -- runtime contracts: PaddedTour --------------------------------------------
+
+def test_check_padded_tour_boundaries():
+    from tsp_mpi_reduction_tpu.ops.merge import PaddedTour, make_padded
+
+    t = make_padded([0, 1, 2, 0], 4, 10.0, capacity=8)
+    assert contracts.check_padded_tour(t, capacity=8) is t
+    bad_ids = PaddedTour(t.ids.astype(jnp.int64), t.length, t.cost)
+    with pytest.raises(contracts.ContractError, match="int32"):
+        contracts.check_padded_tour(bad_ids)
+    bad_len = PaddedTour(t.ids, t.length.astype(jnp.float32), t.cost)
+    with pytest.raises(contracts.ContractError, match="integer"):
+        contracts.check_padded_tour(bad_len)
+    with pytest.raises(contracts.ContractError, match="capacity"):
+        contracts.check_padded_tour(t, capacity=16)
+
+
+def test_merge_tours_contract_rejects_capacity_mismatch():
+    """The boundary contract fires at trace time on malformed operands
+    (batch-shape drift between ids and length)."""
+    from tsp_mpi_reduction_tpu.ops.merge import PaddedTour, merge_tours
+
+    dist = jnp.ones((4, 4))
+    t1 = PaddedTour(jnp.zeros((8,), jnp.int32), jnp.asarray(4, jnp.int32),
+                    jnp.asarray(1.0))
+    bad = PaddedTour(jnp.zeros((2, 8), jnp.int32), jnp.asarray(4, jnp.int32),
+                     jnp.asarray(1.0))
+    with pytest.raises(contracts.ContractError):
+        merge_tours(t1, bad, dist)
+
+
+# -- recompilation guard -------------------------------------------------------
+
+def test_guard_passes_fixed_shape_loop():
+    f = jax.jit(lambda x: x * 2 + 1)
+    f(jnp.ones(8))  # warmup compile outside the guard
+    with contracts.RecompilationGuard({"f": f}, limit=0) as g:
+        for _ in range(5):
+            f(jnp.ones(8))
+    assert g.misses() == {"f": 0}
+
+
+def test_guard_fails_loop_that_rejits_every_call():
+    """The acceptance case: a 'fixed-shape' hot loop that actually re-jits
+    >= 2x per call (shape churn) must FAIL the guarded region."""
+    f = jax.jit(lambda x: x + 1)
+    f(jnp.ones(4))  # warmup
+    with pytest.raises(contracts.RecompilationError, match="recompiled"):
+        with contracts.RecompilationGuard({"hot_loop": f}, limit=0):
+            for i in range(3):
+                f(jnp.ones(5 + i))  # new shape -> new compile, every call
+
+
+def test_guard_budget_allows_intentional_first_compile():
+    f = jax.jit(lambda x: x - 1)
+    with contracts.RecompilationGuard({"f": f}, limit=1):
+        for _ in range(4):
+            f(jnp.ones(16))  # one first-call compile, then cache hits
+
+
+def test_guard_rejects_unjitted_callable():
+    with pytest.raises(ValueError, match="_cache_size"):
+        contracts.RecompilationGuard({"plain": lambda x: x})
+
+
+def test_guard_on_real_engine_entry_point():
+    """The tier-1 wiring the ISSUE asks for: a real fixed-shape engine jit
+    (_reorder_frontier_jit) must not recompile across a steady loop."""
+    fr = _tiny_frontier(n=6, capacity=32)
+    bb._reorder_frontier_jit(fr, rows=32)  # warmup
+    with contracts.RecompilationGuard(
+        {"reorder": bb._reorder_frontier_jit}, limit=0
+    ):
+        for _ in range(4):
+            fr = bb._reorder_frontier_jit(fr, rows=32)
+
+
+def test_guard_does_not_mask_region_exception():
+    f = jax.jit(lambda x: x)
+    f(jnp.ones(2))
+    with pytest.raises(RuntimeError, match="inner"):
+        with contracts.RecompilationGuard({"f": f}, limit=0):
+            f(jnp.ones(3))  # a miss the guard would flag...
+            raise RuntimeError("inner")  # ...but the real error wins
+
+
+# -- the ADVICE round-5 pre-fix patterns, verbatim ----------------------------
+
+def test_r5_flags_prefix_partition_bug():
+    """The literal pre-fix `_partition` shape (ADVICE r5 item 1): clear
+    self.chunks, merge, then `return None` on take==0 without re-spilling
+    — R5 must flag it (the repo's fixed version must NOT be flagged, which
+    `test_repo_is_clean_modulo_checked_in_baseline` enforces)."""
+    vs = lint(
+        """
+        import numpy as np
+
+        class _Reservoir:
+            def _partition(self, extra, inc_cost, capacity):
+                chunks = self.chunks if extra is None else self.chunks + [extra]
+                self.chunks = []
+                chunks = [c for c in chunks if c.shape[0]]
+                merged = np.concatenate(chunks)
+                m = merged.shape[0]
+                take = min(m, capacity // 2)
+                if take == 0:
+                    return None
+                self.chunks.append(merged[take:])
+                return merged[:take]
+        """
+    )
+    assert rules_of(vs) == ["R5"]
+
+
+def test_r1_r2_flag_prefix_exchange_round_trip():
+    """The literal pre-fix `exchange` shape (ADVICE r5 item 3): pull the
+    whole physical buffer, mutate the prefix, re-upload everything — R1
+    must flag the pull and R2 the re-upload."""
+    vs = lint(
+        """
+        import jax.numpy as jnp
+        import numpy as np
+
+        class _Reservoir:
+            def exchange(self, fr, inc_cost, capacity):
+                cnt = int(fr.count)
+                host = np.asarray(fr.nodes).copy()
+                keep = self._partition(host[:cnt], inc_cost, capacity)
+                take = 0 if keep is None else keep.shape[0]
+                if take:
+                    host[:take] = keep
+                return (jnp.asarray(host), take, fr.overflow)
+        """
+    )
+    assert set(rules_of(vs)) == {"R1", "R2"}
+
+
+def test_nested_function_code_not_attributed_to_outer_scope():
+    """ast.walk pruning: a helper DEFINED inside a method/loop gets its own
+    scope — its early returns must not fire R5 against the outer method,
+    and jnp calls in an un-called closure must not fire R4 on the loop."""
+    assert (
+        lint(
+            """
+            class C:
+                def outer(self, x):
+                    self.state = []
+                    cooked = x + 1
+
+                    def helper(y):
+                        z = y + 1
+                        if z:
+                            return None
+                        return z
+
+                    self.state.append(cooked)
+                    return helper
+            """
+        )
+        == []
+    )
+    assert (
+        lint(
+            """
+            import jax.numpy as jnp
+
+            def build(xs):
+                fns = []
+                for x in xs:
+                    def thunk():
+                        return jnp.sum(jnp.ones(3))
+                    fns.append(thunk)
+                return fns
+            """,
+            rules={"R4"},
+        )
+        == []
+    )
+
+
+def test_write_baseline_refuses_partial_surface_into_default(tmp_path, capsys):
+    """--write-baseline over explicit paths must not clobber the repo-wide
+    default baseline (it would drop every accepted site outside them)."""
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent(R4_SRC))
+    assert graftlint_main([str(bad), "--write-baseline"]) == 2
+    assert "refusing" in capsys.readouterr().out
+    # with an explicit --baseline it works fine
+    out = tmp_path / "partial_baseline.json"
+    assert graftlint_main([str(bad), "--write-baseline",
+                           "--baseline", str(out)]) == 0
+    assert graftlint_main([str(bad), "--baseline", str(out)]) == 0
+
+
+def test_cli_nonexistent_path_is_usage_error(tmp_path, capsys):
+    assert graftlint_main([str(tmp_path / "no_such_dir")]) == 2
+    assert "no such path" in capsys.readouterr().out
+
+
+def test_contract_error_is_a_value_error():
+    """CLI entry points wrap kernels in `except ValueError` for a clean
+    exit 2 — contract failures must flow through that path, not escape
+    as raw tracebacks."""
+    assert issubclass(contracts.ContractError, ValueError)

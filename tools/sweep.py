@@ -76,6 +76,16 @@ def main() -> int:
                 "or a different --out)"
             )
 
+    # lint gate before burning hours of sweep: a hot-loop host pull or
+    # re-jitting loop (graftlint R1-R5, README) invalidates the timing
+    # columns this harness exists to produce
+    from tsp_mpi_reduction_tpu.analysis.__main__ import main as graftlint
+    if graftlint(["--quiet"]) != 0:
+        print("sweep: graftlint found new violations; fix or baseline "
+              "them first (python -m tsp_mpi_reduction_tpu.analysis)",
+              file=sys.stderr)
+        return 2
+
     platform = select_backend(args.backend)
     from tsp_mpi_reduction_tpu.utils.backend import enable_persistent_cache
 

@@ -1,0 +1,26 @@
+"""Static analysis + runtime contracts for the JAX engine.
+
+Two layers, one goal — stop the ADVICE.md hazard classes from regressing
+silently:
+
+- ``graftlint``: stdlib-only AST lint (rules R1-R5) over the package; CLI is
+  ``python -m tsp_mpi_reduction_tpu.analysis`` (wired into ``make lint``).
+- ``contracts``: cheap runtime shape/dtype contracts on the Frontier /
+  PaddedTour boundaries plus a jit recompilation guard for fixed-shape hot
+  loops (wired into tier-1 tests).
+
+``graftlint`` must stay importable without JAX (it runs before any backend
+exists), so this package init deliberately does NOT import ``contracts``
+eagerly — import it as ``from tsp_mpi_reduction_tpu.analysis import
+contracts`` where needed.
+"""
+
+from .graftlint import (  # noqa: F401
+    RULES,
+    Violation,
+    apply_baseline,
+    lint_paths,
+    lint_text,
+    load_baseline,
+    write_baseline,
+)

@@ -1,0 +1,122 @@
+"""CLI: ``python -m tsp_mpi_reduction_tpu.analysis [paths...]``.
+
+Exit status 0 when the tree is clean modulo the checked-in baseline,
+1 when new violations exist, 2 on usage errors. Runs stdlib-only (no JAX
+import), so it is safe as the first stage of ``make lint`` / the sweep
+harness even on machines with no accelerator runtime.
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import sys
+
+from .graftlint import (
+    RULES,
+    apply_baseline,
+    lint_paths,
+    load_baseline,
+    write_baseline,
+)
+
+_PKG_DIR = pathlib.Path(__file__).resolve().parent.parent  # the package
+_REPO_ROOT = _PKG_DIR.parent
+_DEFAULT_BASELINE = pathlib.Path(__file__).resolve().parent / "graftlint_baseline.json"
+#: default lint surface: the package plus the perf-harness scripts that sit
+#: on the same hot paths (tests are excluded — their fixtures intentionally
+#: contain violating snippets)
+_DEFAULT_TARGETS = [_PKG_DIR, _REPO_ROOT / "tools", _REPO_ROOT / "bench.py"]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftlint", description="JAX-hazard lint (rules R1-R5)"
+    )
+    ap.add_argument(
+        "paths",
+        nargs="*",
+        type=pathlib.Path,
+        help="files/dirs to lint (default: the package, tools/, bench.py)",
+    )
+    ap.add_argument(
+        "--rules",
+        default=",".join(sorted(RULES)),
+        help="comma-separated rule subset (default: all)",
+    )
+    ap.add_argument(
+        "--baseline",
+        type=pathlib.Path,
+        default=_DEFAULT_BASELINE,
+        help="baseline JSON of accepted sites",
+    )
+    ap.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="report every violation, ignoring the baseline",
+    )
+    ap.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="accept the current violations as the new baseline",
+    )
+    ap.add_argument(
+        "--quiet", action="store_true", help="summary line only"
+    )
+    args = ap.parse_args(argv)
+
+    rules = {r.strip() for r in args.rules.split(",") if r.strip()}
+    unknown = rules - set(RULES)
+    if unknown:
+        print(f"graftlint: unknown rule(s): {', '.join(sorted(unknown))}")
+        return 2
+
+    if args.paths:
+        # an explicit path that doesn't exist is a usage error, not a
+        # clean run — a typo'd CI invocation must not turn the gate green
+        missing = [p for p in args.paths if not p.exists()]
+        if missing:
+            print(
+                "graftlint: no such path(s): "
+                + ", ".join(str(p) for p in missing)
+            )
+            return 2
+        targets = list(args.paths)
+    else:
+        targets = [p for p in _DEFAULT_TARGETS if p.exists()]
+    violations = lint_paths(targets, root=_REPO_ROOT, rules=rules)
+
+    if args.write_baseline:
+        if args.paths and args.baseline == _DEFAULT_BASELINE:
+            # a partial lint surface must not clobber the repo-wide
+            # baseline (it would drop every accepted site outside `paths`)
+            print(
+                "graftlint: refusing --write-baseline for explicit paths "
+                "into the default baseline; pass --baseline PATH"
+            )
+            return 2
+        write_baseline(args.baseline, violations)
+        print(
+            f"graftlint: baseline written to {args.baseline} "
+            f"({len(violations)} accepted sites)"
+        )
+        return 0
+
+    baseline = {} if args.no_baseline else load_baseline(args.baseline)
+    res = apply_baseline(violations, baseline)
+
+    if not args.quiet:
+        for v in res.new:
+            print(v.render())
+        for fp in res.stale:
+            print(f"graftlint: stale baseline entry (fixed? regenerate): {fp}")
+    print(
+        f"graftlint: {len(res.new)} new, {len(res.accepted)} baselined, "
+        f"{len(res.stale)} stale baseline entries "
+        f"({len(targets)} target(s), rules {','.join(sorted(rules))})"
+    )
+    return 1 if res.new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

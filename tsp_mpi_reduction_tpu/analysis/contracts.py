@@ -1,0 +1,218 @@
+"""Runtime contracts: boundary shape/dtype asserts + a jit recompilation guard.
+
+The static pass (``graftlint``) catches hazard *patterns*; this layer catches
+the two failure classes that only exist at runtime:
+
+- **Boundary corruption.** ``Frontier`` and ``PaddedTour`` are bare
+  NamedTuples of arrays — nothing stops a caller from handing a float64
+  buffer, a transposed node matrix, or a row width that doesn't invert to a
+  valid ``(n, W)`` layout. ``check_frontier`` / ``check_padded_tour`` verify
+  the structural invariants using METADATA ONLY (shape + dtype — no device
+  sync, safe inside a trace), so they are cheap enough to stay on in
+  production. ``TSP_CONTRACTS=strict`` adds value-level checks (count within
+  the buffer, length within capacity) that sync concrete arrays to host —
+  test-suite territory. ``TSP_CONTRACTS=off`` disables everything.
+
+- **Silent recompilation.** A fixed-shape hot loop that re-jits every call
+  turns a microsecond dispatch into a multi-second compile — and nothing in
+  JAX fails loudly when it happens (the round-5 TPU campaign found exactly
+  this through wall-clock archaeology). ``RecompilationGuard`` snapshots the
+  jit caches of named entry points (via ``jitted._cache_size()``) and raises
+  ``RecompilationError`` when a guarded region compiles more entries than its
+  budget. Tier-1 runs the B&B expand loop under a zero-budget guard after
+  warmup, so a shape leak (weak-typed scalar, python float promoted per
+  iteration, changed static arg) fails the suite instead of shipping a 100x
+  slowdown.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Mapping, Optional
+
+import jax
+import numpy as np
+
+
+class ContractError(ValueError):
+    """A structural invariant on a kernel boundary was violated.
+
+    Subclasses ValueError so existing callers that wrap kernel entry
+    points in ``except ValueError`` (e.g. the CLI's clean exit-2 path)
+    treat contract failures like any other input-validation error."""
+
+
+class RecompilationError(ContractError):
+    """A guarded fixed-shape region triggered unexpected jit compiles."""
+
+
+def level() -> str:
+    """Contract level: "off", "on" (default; metadata checks only), or
+    "strict" (adds value checks that sync concrete arrays)."""
+    val = os.environ.get("TSP_CONTRACTS", "on").strip().lower()
+    return val if val in ("off", "on", "strict") else "on"
+
+
+def _is_concrete(x: Any) -> bool:
+    return not isinstance(x, jax.core.Tracer)
+
+
+def _shape(x: Any) -> tuple:
+    return tuple(np.shape(x))
+
+
+def _dtype(x: Any) -> np.dtype:
+    """dtype of an array/tracer, mapping plain python scalars through
+    numpy's defaults (a bare float cost is a legal scalar leaf)."""
+    dt = getattr(x, "dtype", None)
+    return np.dtype(dt) if dt is not None else np.dtype(type(x))
+
+
+def _frontier_layout(cols: int) -> Optional[int]:
+    """Invert ``cols = n + ceil(n/32) + 4`` -> n, or None if not a valid
+    packed-row width (mirrors models.branch_bound._layout, duplicated so
+    the analysis package never imports the engine)."""
+    n = max((cols - 4) * 32 // 33, 1)
+    for cand in range(max(n - 2, 1), n + 3):
+        if cand + (cand + 31) // 32 + 4 == cols:
+            return cand
+    return None
+
+
+def _fail(where: str, msg: str) -> None:
+    prefix = f"{where}: " if where else ""
+    raise ContractError(f"contract violation: {prefix}{msg}")
+
+
+def check_frontier(fr, *, n: Optional[int] = None, where: str = ""):
+    """Validate a Frontier's structural invariants; returns ``fr``.
+
+    Accepts single-device ``[F, cols]`` node buffers and sharded stacked
+    ``[R, F, cols]`` ones. Metadata-only by default (tracer-safe).
+    """
+    lv = level()
+    if lv == "off":
+        return fr
+    nodes, count, overflow = fr.nodes, fr.count, fr.overflow
+    if nodes.ndim not in (2, 3):
+        _fail(where, f"Frontier.nodes must be [F, cols] or [R, F, cols], got {nodes.shape}")
+    if nodes.dtype != np.int32:
+        _fail(where, f"Frontier.nodes must be int32 packed rows, got {nodes.dtype}")
+    cols = nodes.shape[-1]
+    got_n = _frontier_layout(cols)
+    if got_n is None:
+        _fail(where, f"Frontier row width {cols} inverts to no valid (n, W) layout")
+    if n is not None and got_n != n:
+        _fail(where, f"Frontier row width {cols} encodes n={got_n}, expected n={n}")
+    want_count_shape = () if nodes.ndim == 2 else nodes.shape[:1]
+    if tuple(count.shape) != want_count_shape:
+        _fail(where, f"Frontier.count shape {count.shape}, expected {want_count_shape}")
+    if not np.issubdtype(count.dtype, np.integer):
+        _fail(where, f"Frontier.count must be integer, got {count.dtype}")
+    if overflow.dtype != np.bool_:
+        _fail(where, f"Frontier.overflow must be bool, got {overflow.dtype}")
+    if lv == "strict" and _is_concrete(count) and _is_concrete(nodes):
+        cnt = np.asarray(count)
+        rows = nodes.shape[-2]
+        if (cnt < 0).any() or (cnt > rows).any():
+            _fail(where, f"Frontier.count {cnt} outside [0, {rows}] buffer rows")
+    return fr
+
+
+def check_padded_tour(t, *, capacity: Optional[int] = None, where: str = ""):
+    """Validate a PaddedTour's structural invariants; returns ``t``.
+
+    Accepts scalar tours (``ids [P]``) and batched ones (``ids [..., P]``
+    with matching-batch length/cost), as produced by the vmapped folds.
+    """
+    lv = level()
+    if lv == "off":
+        return t
+    ids, length, cost = t.ids, t.length, t.cost
+    if len(_shape(ids)) < 1:
+        _fail(where, f"PaddedTour.ids must have a capacity axis, got shape {_shape(ids)}")
+    if _dtype(ids) != np.int32:
+        _fail(where, f"PaddedTour.ids must be int32 city ids, got {_dtype(ids)}")
+    batch = _shape(ids)[:-1]
+    if _shape(length) != batch:
+        _fail(where, f"PaddedTour.length shape {_shape(length)} != batch {batch}")
+    if _shape(cost) != batch:
+        _fail(where, f"PaddedTour.cost shape {_shape(cost)} != batch {batch}")
+    if not np.issubdtype(_dtype(length), np.integer):
+        _fail(where, f"PaddedTour.length must be integer, got {_dtype(length)}")
+    if not np.issubdtype(_dtype(cost), np.floating):
+        _fail(where, f"PaddedTour.cost must be floating, got {_dtype(cost)}")
+    if capacity is not None and _shape(ids)[-1] != capacity:
+        _fail(where, f"PaddedTour capacity {_shape(ids)[-1]}, expected {capacity}")
+    if lv == "strict" and _is_concrete(length):
+        ln = np.asarray(length)
+        if (ln < 0).any() or (ln > ids.shape[-1]).any():
+            _fail(where, f"PaddedTour.length {ln} outside [0, {ids.shape[-1]}]")
+    return t
+
+
+# -- recompilation guard ------------------------------------------------------
+
+
+def jit_cache_size(fn) -> Optional[int]:
+    """Number of compiled entries in a jitted callable's cache, or None if
+    the callable doesn't expose one (plain python function, older jax)."""
+    size = getattr(fn, "_cache_size", None)
+    if size is None:
+        return None
+    try:
+        return int(size())
+    except Exception:
+        return None
+
+
+class RecompilationGuard:
+    """Fail when named jit entry points compile more than ``limit`` new
+    cache entries inside the guarded region.
+
+    >>> with RecompilationGuard({"expand": _expand_step}, limit=0):
+    ...     for _ in range(100):
+    ...         fr, *_ = _expand_step(fr, ...)   # fixed shapes: 0 compiles
+
+    Entry points must be jitted callables (``jax.jit`` / ``pjit`` results —
+    anything exposing ``_cache_size()``). A fixed-shape loop warmed up
+    before entry must stay at zero misses; ``limit`` budgets intentional
+    first-call compiles when warmup happens inside the region.
+    """
+
+    def __init__(self, entries: Mapping[str, Any], limit: int = 0):
+        unknown = [k for k, fn in entries.items() if jit_cache_size(fn) is None]
+        if unknown:
+            raise ValueError(
+                f"not jitted callables (no _cache_size): {', '.join(unknown)}"
+            )
+        self.entries = dict(entries)
+        self.limit = int(limit)
+        self._before: Dict[str, int] = {}
+
+    def __enter__(self) -> "RecompilationGuard":
+        self._before = {k: jit_cache_size(fn) for k, fn in self.entries.items()}
+        return self
+
+    def misses(self) -> Dict[str, int]:
+        """New cache entries per entry point since ``__enter__``."""
+        return {
+            k: jit_cache_size(fn) - self._before.get(k, 0)
+            for k, fn in self.entries.items()
+        }
+
+    def check(self) -> None:
+        over = {k: m for k, m in self.misses().items() if m > self.limit}
+        if over:
+            detail = ", ".join(
+                f"{k}: {m} new compile(s) (budget {self.limit})"
+                for k, m in sorted(over.items())
+            )
+            raise RecompilationError(
+                "fixed-shape region recompiled — a shape/dtype/static-arg is "
+                f"churning per call: {detail}"
+            )
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is None:  # don't mask the region's own exception
+            self.check()

@@ -1,0 +1,719 @@
+"""graftlint: AST lint pass for the JAX hazard classes this repo actually hits.
+
+The engine's real failure modes are JAX-specific, not generic Python bugs —
+ADVICE.md round 5 recorded three live defects (a ``take==0`` node-discard
+exactness bug, an every-spill full-reservoir merge, and a full-physical-buffer
+host round-trip on every spill) that neither pyflakes-style linting nor the
+test suite caught. Each belongs to a hazard class that is mechanically
+detectable from the AST:
+
+  R1  host-pull-in-hot-loop: ``np.asarray`` / ``np.array`` / ``jax.device_get``
+      / ``.copy()`` applied to a device buffer inside a loop body or a known
+      hot-path function — every occurrence is a device->host transfer on the
+      search's critical path (the exact shape of ADVICE round-5 item 3).
+  R2  round-trip-reupload: ``jnp.asarray(x)`` / ``jax.device_put(x)`` where
+      ``x`` was pulled from the device earlier in the same function — the
+      down-modify-up pattern; a sliced ``buf.at[:k].set(...)`` uploads only
+      the mutated prefix instead of the whole physical buffer.
+  R3  branch-on-jitted-output: Python ``if``/``while`` on a value returned by
+      a jitted callee without an explicit ``float()``/``int()``/``bool()``
+      scalar conversion — a silent sync today, a tracer leak the moment the
+      enclosing code is itself traced.
+  R4  jnp-in-python-loop: ``jnp``/``lax`` calls inside a Python ``for`` body —
+      the loop unrolls at trace time (compile-time blowup) or relaunches
+      kernels per iteration; ``lax.scan``/``fori_loop``/``vmap`` keep it one
+      kernel.
+  R5  early-return-drops-state: a function overwrites ``self.<attr>`` state,
+      computes locals from it, then has an early ``return None`` path that
+      writes nothing back — the ``_partition`` ``take==0`` bug class, where
+      ``self.chunks`` was cleared and the merged alive rows silently dropped.
+
+Escape hatches (both are honored, in this order):
+
+- ``# graftlint: disable=R1,R4`` on the flagged line, the line above, or the
+  ``def`` line of the enclosing function (which disables for the whole body);
+  bare ``# graftlint: disable`` silences every rule.
+- a checked-in baseline (``graftlint_baseline.json`` next to this module):
+  accepted pre-existing sites, keyed by (path, rule, scope, code-text) so
+  line-number churn never invalidates it. ``--write-baseline`` regenerates.
+
+``# graftlint: hot`` on a ``def`` line marks that function as a hot path, so
+R1 applies to its whole body (not only lexical loop bodies); the functions in
+``DEFAULT_HOT_PATHS`` — the reservoir spill/refill machinery this repo knows
+is on the per-spill critical path — are treated as hot by default.
+
+The pass is stdlib-only (``ast`` + ``tokenize``): it must run in CI and the
+sweep harness before any JAX import, and must never drag device state into a
+lint step.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import pathlib
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+RULES = {
+    "R1": "device->host pull inside a hot loop",
+    "R2": "whole-buffer re-upload of a host round-trip",
+    "R3": "Python control flow on a jitted callee's output",
+    "R4": "jnp call inside a Python for loop",
+    "R5": "early return None drops mutated self state",
+}
+
+#: functions whose WHOLE body R1 treats as a hot loop: the reservoir
+#: spill/refill machinery runs once per spill event inside the solve loop,
+#: so a host pull here is a per-spill transfer even without a lexical loop.
+DEFAULT_HOT_PATHS = frozenset(
+    {
+        "exchange",
+        "refill",
+        "_keep_live_only",
+        "spill_refill",
+        "_expand_loop",
+    }
+)
+
+#: attribute names that name device-resident buffers in this codebase
+#: (Frontier / PaddedTour fields) — ``np.asarray(fr.nodes)`` is a device
+#: pull even though ``fr`` itself is just a parameter name to the AST.
+DEVICE_ATTRS = frozenset({"nodes", "count", "overflow", "ids", "length", "cost"})
+
+#: modules whose calls produce device arrays
+_DEVICE_MODULES = ("jnp", "jax")
+#: host-pull callables (R1) — dotted names
+_HOST_PULL_CALLS = frozenset(
+    {"np.asarray", "np.array", "numpy.asarray", "numpy.array", "jax.device_get"}
+)
+#: device-upload callables (R2)
+_UPLOAD_CALLS = frozenset(
+    {"jnp.asarray", "jnp.array", "jax.device_put", "jax.numpy.asarray"}
+)
+#: scalar conversions that launder a jitted output for host control flow (R3)
+_SCALAR_CONVERSIONS = frozenset({"float", "int", "bool", "len"})
+_SCALAR_CONVERSION_ATTRS = frozenset(
+    {"np.asarray", "np.array", "numpy.asarray", "numpy.array", "np.float64"}
+)
+#: call roots that count as "jnp work" inside a for loop (R4)
+_JNP_ROOTS = frozenset({"jnp", "lax"})
+
+
+@dataclass(frozen=True)
+class Violation:
+    path: str  # repo-relative posix path
+    line: int
+    rule: str
+    scope: str  # qualified function name, or "<module>"
+    code: str  # stripped source of the flagged line
+    message: str
+
+    @property
+    def fingerprint(self) -> str:
+        """Line-number-free identity used by the baseline (stable across
+        unrelated edits; moves with the code text itself)."""
+        return f"{self.path}::{self.rule}::{self.scope}::{self.code}"
+
+    def render(self) -> str:
+        return (
+            f"{self.path}:{self.line}: {self.rule} [{self.scope}] "
+            f"{self.message}\n    {self.code}"
+        )
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` -> "a.b.c" for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _walk_own(root: ast.AST):
+    """ast.walk over ``root``'s OWN code: nested function/lambda bodies are
+    pruned (ast.walk's flat iteration would attribute their statements to
+    the enclosing scope — they get their own visit instead)."""
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            stack.append(child)
+
+
+def _root_name(node: ast.AST) -> Optional[str]:
+    """Leftmost Name of an attribute/subscript/call chain."""
+    while True:
+        if isinstance(node, ast.Attribute):
+            node = node.value
+        elif isinstance(node, ast.Subscript):
+            node = node.value
+        elif isinstance(node, ast.Call):
+            node = node.func
+        elif isinstance(node, ast.Name):
+            return node.id
+        else:
+            return None
+
+
+class _Directives:
+    """Per-line ``# graftlint: ...`` comment directives, via tokenize (the
+    AST drops comments). ``disable[line]`` is a rule set; ``{"*"}`` = all."""
+
+    def __init__(self, source: str):
+        self.disable: Dict[int, Set[str]] = {}
+        self.hot_lines: Set[int] = set()
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                # the directive may trail prose in the same comment:
+                # "# one fetch per spill  # graftlint: disable=R1"
+                marker = tok.string.find("graftlint:")
+                if marker < 0:
+                    continue
+                body = tok.string[marker + len("graftlint:"):].strip()
+                if body.startswith("disable"):
+                    _, _, spec = body.partition("=")
+                    rules = (
+                        {r.strip().split()[0] for r in spec.split(",") if r.strip()}
+                        if "=" in body
+                        else {"*"}
+                    )
+                    self.disable.setdefault(tok.start[0], set()).update(rules)
+                elif body.startswith("hot"):
+                    self.hot_lines.add(tok.start[0])
+        except tokenize.TokenError:
+            pass
+
+    def suppressed(self, line: int, rule: str, def_line: Optional[int]) -> bool:
+        for ln in (line, line - 1, def_line):
+            if ln is None:
+                continue
+            rules = self.disable.get(ln)
+            if rules and ("*" in rules or rule in rules):
+                return True
+        return False
+
+
+def _jitted_names(tree: ast.Module) -> Set[str]:
+    """Module-level names bound to jitted callables: ``f = jax.jit(...)``
+    assignments and ``@jax.jit`` / ``@partial(jax.jit, ...)`` decorated defs."""
+
+    def is_jit_call(node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        name = _dotted(node.func)
+        if name in ("jax.jit", "jit", "pjit", "jax.pjit"):
+            return True
+        # partial(jax.jit, ...) / functools.partial(jax.jit, ...)
+        if name in ("partial", "functools.partial") and node.args:
+            return _dotted(node.args[0]) in ("jax.jit", "jit")
+        return False
+
+    jitted: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and is_jit_call(node.value):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    jitted.add(tgt.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if is_jit_call(dec) or _dotted(dec) in ("jax.jit", "jit"):
+                    jitted.add(node.name)
+    return jitted
+
+
+class _FileLinter(ast.NodeVisitor):
+    def __init__(
+        self,
+        path: str,
+        source: str,
+        tree: ast.Module,
+        rules: Set[str],
+        hot_paths: Set[str],
+    ):
+        self.path = path
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.rules = rules
+        self.hot_paths = hot_paths
+        self.directives = _Directives(source)
+        self.jitted = _jitted_names(tree)
+        self.violations: List[Violation] = []
+        # lexical state
+        self.scope: List[str] = []
+        self.def_lines: List[int] = []
+        self.loop_depth = 0
+        self.for_depth = 0
+        self.hot = False
+        self.device_names: Set[str] = set()  # assigned from jnp./jax. calls
+        self.pulled_names: Set[str] = set()  # assigned from host pulls
+        self.tainted: Set[str] = set()  # assigned raw from jitted callees
+
+    # -- reporting ---------------------------------------------------------
+
+    def _emit(self, node: ast.AST, rule: str, message: str) -> None:
+        if rule not in self.rules:
+            return
+        line = getattr(node, "lineno", 1)
+        def_line = self.def_lines[-1] if self.def_lines else None
+        if self.directives.suppressed(line, rule, def_line):
+            return
+        code = (
+            self.lines[line - 1].strip() if 0 < line <= len(self.lines) else ""
+        )
+        scope = ".".join(self.scope) if self.scope else "<module>"
+        self.violations.append(
+            Violation(self.path, line, rule, scope, code, message)
+        )
+
+    # -- scope bookkeeping -------------------------------------------------
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._visit_func(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._visit_func(node)
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.scope.append(node.name)
+        self.generic_visit(node)
+        self.scope.pop()
+
+    def _visit_func(self, node) -> None:
+        saved = (
+            self.hot,
+            self.loop_depth,
+            self.for_depth,
+            self.device_names,
+            self.pulled_names,
+            self.tainted,
+        )
+        self.scope.append(node.name)
+        self.def_lines.append(node.lineno)
+        self.hot = node.name in self.hot_paths or any(
+            ln in self.directives.hot_lines
+            for ln in range(node.lineno, node.body[0].lineno)
+        )
+        self.loop_depth = 0
+        self.for_depth = 0
+        self.device_names = set()
+        self.pulled_names = set()
+        self.tainted = set()
+        self._check_r5(node)
+        for child in node.body:
+            self.visit(child)
+        self.def_lines.pop()
+        self.scope.pop()
+        (
+            self.hot,
+            self.loop_depth,
+            self.for_depth,
+            self.device_names,
+            self.pulled_names,
+            self.tainted,
+        ) = saved
+
+    # -- loops -------------------------------------------------------------
+
+    def visit_For(self, node: ast.For) -> None:
+        self._check_r4(node)
+        self.loop_depth += 1
+        self.for_depth += 1
+        self.generic_visit(node)
+        self.for_depth -= 1
+        self.loop_depth -= 1
+
+    def visit_While(self, node: ast.While) -> None:
+        self._check_r3_test(node)
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    def visit_If(self, node: ast.If) -> None:
+        self._check_r3_test(node)
+        self.generic_visit(node)
+
+    # -- assignments: taint / device / pulled tracking ----------------------
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self._track_assignment(node.targets, node.value)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self.generic_visit(node)
+
+    def _target_names(self, targets) -> List[str]:
+        names: List[str] = []
+        for tgt in targets:
+            if isinstance(tgt, ast.Name):
+                names.append(tgt.id)
+            elif isinstance(tgt, (ast.Tuple, ast.List)):
+                names.extend(self._target_names(tgt.elts))
+        return names
+
+    def _track_assignment(self, targets, value) -> None:
+        names = self._target_names(targets)
+        if not names:
+            return
+        for group in (self.device_names, self.pulled_names, self.tainted):
+            group.difference_update(names)  # rebinding clears prior status
+        if self._is_device_producer(value):
+            self.device_names.update(names)
+        if self._is_host_pull(value):
+            self.pulled_names.update(names)
+        if self._is_raw_jitted_call(value):
+            self.tainted.update(names)
+
+    def _is_device_producer(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Call):
+            name = _dotted(node.func) or ""
+            root = name.split(".", 1)[0]
+            if root in _DEVICE_MODULES and name not in (
+                "jax.device_get",
+            ):
+                return True
+            # buf.at[...].set(...) produces a new device buffer
+            if name.endswith(".set") and ".at" in name:
+                return True
+        return False
+
+    def _is_host_pull(self, node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        name = _dotted(node.func)
+        if name in _HOST_PULL_CALLS:
+            return True
+        # np.asarray(...).copy() chains
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "copy"
+            and self._is_host_pull(node.func.value)
+        ):
+            return True
+        return False
+
+    def _is_raw_jitted_call(self, node: ast.AST) -> bool:
+        """A call to a known-jitted callee NOT wrapped in a scalar
+        conversion; subscripts of such calls stay raw."""
+        if isinstance(node, ast.Subscript):
+            return self._is_raw_jitted_call(node.value)
+        if not isinstance(node, ast.Call):
+            return False
+        name = _dotted(node.func)
+        if name in _SCALAR_CONVERSIONS or name in _SCALAR_CONVERSION_ATTRS:
+            return False
+        return name in self.jitted
+
+    # -- calls: R1 / R2 ------------------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = _dotted(node.func)
+        in_hot = self.loop_depth > 0 or self.hot
+        if in_hot and name in _HOST_PULL_CALLS and node.args:
+            if self._is_device_expr(node.args[0]):
+                self._emit(
+                    node,
+                    "R1",
+                    f"{name}() pulls a device buffer to host inside a hot "
+                    "loop — hoist it out or keep the data on device",
+                )
+        if (
+            in_hot
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "copy"
+            and not node.args
+            and self._is_device_expr(node.func.value)
+        ):
+            self._emit(
+                node,
+                "R1",
+                ".copy() of a device buffer inside a hot loop — a full "
+                "host materialization per iteration",
+            )
+        # R2 only fires in hot contexts: a one-time down-compute-up round
+        # trip in setup code is legitimate; per-spill/per-iteration
+        # re-uploads of a whole pulled buffer are the hazard
+        if in_hot and name in _UPLOAD_CALLS and node.args:
+            arg = node.args[0]
+            if isinstance(arg, ast.Name) and arg.id in self.pulled_names:
+                self._emit(
+                    node,
+                    "R2",
+                    f"{name}({arg.id}) re-uploads a buffer pulled from the "
+                    "device in this function — write the mutated slice back "
+                    "in place with buf.at[:k].set(...) instead",
+                )
+        self.generic_visit(node)
+
+    def _is_device_expr(self, node: ast.AST) -> bool:
+        """Heuristic: does this expression name a device buffer?"""
+        if isinstance(node, ast.Name):
+            return node.id in self.device_names
+        if isinstance(node, ast.Attribute):
+            return node.attr in DEVICE_ATTRS or self._is_device_expr(node.value)
+        if isinstance(node, ast.Subscript):
+            return self._is_device_expr(node.value)
+        if isinstance(node, ast.Call):
+            return self._is_device_producer(node) or self._is_host_pull(
+                node
+            ) and any(
+                self._is_device_expr(a) for a in node.args
+            )
+        return False
+
+    # -- R3: control flow on jitted outputs ---------------------------------
+
+    def _check_r3_test(self, node) -> None:
+        if "R3" not in self.rules:
+            return
+        naked = self._naked_tainted_names(node.test)
+        for name in sorted(naked):
+            self._emit(
+                node,
+                "R3",
+                f"Python {type(node).__name__.lower()} on `{name}`, a raw "
+                "output of a jitted callee — convert with float()/int()/"
+                "bool() first (tracer-leak risk if this code is ever traced)",
+            )
+
+    def _naked_tainted_names(self, test: ast.AST) -> Set[str]:
+        """Tainted Names in a test expression not wrapped in a scalar
+        conversion call."""
+        naked: Set[str] = set()
+
+        def walk(node: ast.AST) -> None:
+            if isinstance(node, ast.Call):
+                name = _dotted(node.func)
+                if name in _SCALAR_CONVERSIONS or name in _SCALAR_CONVERSION_ATTRS:
+                    return  # converted — whatever is inside is laundered
+                if self._is_raw_jitted_call(node):
+                    naked.add(name or "<call>")
+            if isinstance(node, ast.Name) and node.id in self.tainted:
+                naked.add(node.id)
+            for child in ast.iter_child_nodes(node):
+                walk(child)
+
+        walk(test)
+        return naked
+
+    # -- R4: jnp work in a python for loop ----------------------------------
+
+    def _check_r4(self, node: ast.For) -> None:
+        if "R4" not in self.rules:
+            return
+        for sub in _walk_own(node):
+            if isinstance(sub, ast.Call):
+                name = _dotted(sub.func) or ""
+                root = name.split(".", 1)[0]
+                is_jnp = root in _JNP_ROOTS or name.startswith(
+                    ("jax.lax.", "jax.numpy.", "jax.nn.")
+                )
+                if is_jnp:
+                    # anchor on the for statement so a loop-line disable
+                    # covers every jnp call in the body
+                    self._emit(
+                        node,
+                        "R4",
+                        f"{name}() (line {sub.lineno}) inside a Python for "
+                        "loop — the loop unrolls at trace time / relaunches "
+                        "kernels; use lax.scan, lax.fori_loop, or vmap",
+                    )
+                    return  # one violation per loop
+
+    # -- R5: early return None drops mutated self state ----------------------
+
+    def _check_r5(self, func) -> None:
+        if "R5" not in self.rules:
+            return
+        body = func.body
+        # lexical positions of self.<attr> OVERWRITES and write-backs
+        overwrites: List[int] = []
+        writebacks: List[int] = []
+        assigns: List[int] = []  # local name bindings
+        returns_none: List[ast.Return] = []
+        last_stmt_line = body[-1].lineno if body else func.lineno
+
+        for node in _walk_own(func):
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if (
+                        isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"
+                    ):
+                        overwrites.append(node.lineno)
+                        writebacks.append(node.lineno)
+                    elif isinstance(tgt, ast.Name):
+                        assigns.append(node.lineno)
+            elif isinstance(node, ast.Call):
+                # self.X.append(...) / extend / insert / update write-backs
+                name = _dotted(node.func) or ""
+                if name.startswith("self.") and name.rsplit(".", 1)[-1] in (
+                    "append",
+                    "extend",
+                    "insert",
+                    "update",
+                    "add",
+                ):
+                    writebacks.append(node.lineno)
+            elif isinstance(node, ast.Return):
+                is_none = node.value is None or (
+                    isinstance(node.value, ast.Constant)
+                    and node.value.value is None
+                )
+                if is_none and node.lineno < last_stmt_line:
+                    returns_none.append(node)
+
+        if not overwrites:
+            return
+        first_ow = min(overwrites)
+        for ret in returns_none:
+            if ret.lineno <= first_ow:
+                continue
+            # state computed after the overwrite but before the return?
+            computed = [ln for ln in assigns if first_ow < ln < ret.lineno]
+            if not computed:
+                continue
+            # any write-back strictly between overwrite and return clears it
+            saved = [ln for ln in writebacks if first_ow < ln < ret.lineno]
+            if saved:
+                continue
+            self._emit(
+                ret,
+                "R5",
+                "early `return None` after overwriting self state with "
+                "locals computed but never written back — mutated state is "
+                "dropped (the _partition take==0 bug class)",
+            )
+
+    # -- driver --------------------------------------------------------------
+
+    def run(self) -> List[Violation]:
+        for node in self.tree.body:
+            self.visit(node)
+        return self.violations
+
+
+# -- public API ---------------------------------------------------------------
+
+
+def lint_text(
+    source: str,
+    path: str = "<string>",
+    rules: Optional[Iterable[str]] = None,
+    hot_paths: Optional[Iterable[str]] = None,
+) -> List[Violation]:
+    """Lint one source string; returns violations (disable comments already
+    honored, baseline NOT applied)."""
+    tree = ast.parse(source, filename=path)
+    linter = _FileLinter(
+        path,
+        source,
+        tree,
+        set(rules) if rules is not None else set(RULES),
+        set(hot_paths) if hot_paths is not None else set(DEFAULT_HOT_PATHS),
+    )
+    return linter.run()
+
+
+def _iter_py_files(paths: Sequence[pathlib.Path]) -> List[pathlib.Path]:
+    files: List[pathlib.Path] = []
+    for p in paths:
+        if p.is_dir():
+            files.extend(sorted(p.rglob("*.py")))
+        elif p.suffix == ".py":
+            files.append(p)
+    return files
+
+
+def lint_paths(
+    paths: Sequence[pathlib.Path],
+    root: pathlib.Path,
+    rules: Optional[Iterable[str]] = None,
+) -> List[Violation]:
+    """Lint every .py under ``paths``; violation paths are ``root``-relative."""
+    out: List[Violation] = []
+    for f in _iter_py_files(paths):
+        try:
+            source = f.read_text()
+        except (OSError, UnicodeDecodeError):
+            continue
+        try:
+            rel = f.resolve().relative_to(root.resolve()).as_posix()
+        except ValueError:
+            rel = f.as_posix()
+        try:
+            out.extend(lint_text(source, rel, rules=rules))
+        except SyntaxError:
+            continue
+    return out
+
+
+# -- baseline -----------------------------------------------------------------
+
+
+@dataclass
+class BaselineResult:
+    new: List[Violation] = field(default_factory=list)
+    accepted: List[Violation] = field(default_factory=list)
+    stale: List[str] = field(default_factory=list)
+
+
+def load_baseline(path: pathlib.Path) -> Dict[str, int]:
+    if not path.exists():
+        return {}
+    data = json.loads(path.read_text())
+    return {str(k): int(v) for k, v in data.get("entries", {}).items()}
+
+
+def write_baseline(path: pathlib.Path, violations: Sequence[Violation]) -> None:
+    counts: Dict[str, int] = {}
+    for v in violations:
+        counts[v.fingerprint] = counts.get(v.fingerprint, 0) + 1
+    path.write_text(
+        json.dumps(
+            {
+                "comment": (
+                    "graftlint accepted-site baseline: pre-existing "
+                    "violations keyed path::rule::scope::code (line-free). "
+                    "Regenerate with: python -m tsp_mpi_reduction_tpu.analysis "
+                    "--write-baseline"
+                ),
+                "version": 1,
+                "entries": dict(sorted(counts.items())),
+            },
+            indent=2,
+        )
+        + "\n"
+    )
+
+
+def apply_baseline(
+    violations: Sequence[Violation], baseline: Dict[str, int]
+) -> BaselineResult:
+    """Split violations into new vs baseline-accepted; surplus occurrences of
+    a baselined fingerprint count as new."""
+    budget = dict(baseline)
+    res = BaselineResult()
+    for v in violations:
+        if budget.get(v.fingerprint, 0) > 0:
+            budget[v.fingerprint] -= 1
+            res.accepted.append(v)
+        else:
+            res.new.append(v)
+    res.stale = sorted(k for k, n in budget.items() if n > 0)
+    return res

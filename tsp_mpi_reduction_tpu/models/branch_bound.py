@@ -48,6 +48,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis import contracts as _contracts
+
 INF = jnp.inf
 
 
@@ -701,6 +703,8 @@ def _mst_conn_boruvka(dbar, unvis, cur, n, lam=None):
     rounds = int(np.ceil(np.log2(max(n, 2))))  # components at least halve
     jumps = int(np.ceil(np.log2(max(n, 2))))
 
+    # log-depth Boruvka: `rounds` is a static O(log n) bound and each
+    # round's dependence is sequential  # graftlint: disable=R4
     for _ in range(rounds):
         # per-vertex cheapest outgoing edge (crossing components); argmin's
         # first-index rule picks the smallest partner u among ties, which
@@ -744,6 +748,7 @@ def _mst_conn_boruvka(dbar, unvis, cur, n, lam=None):
         hook = jnp.where(has, partner, jnp.broadcast_to(slots, (k, n)))
         hp = jnp.take_along_axis(hook, hook, axis=1)
         star = jnp.where((hp == slots) & (slots < hook), slots, hook)
+        # static O(log n) pointer-jumping chain  # graftlint: disable=R4
         for _ in range(jumps):
             star = jnp.take_along_axis(star, star, axis=1)
         comp = jnp.take_along_axis(star, comp, axis=1)
@@ -841,6 +846,8 @@ def _batched_mst_bound(
         lam = jnp.zeros((k, n), dbar.dtype) + (p_cost[:, None] * 0)
         step = jnp.asarray(ascent_step, dbar.dtype)
         budget = jnp.asarray(lam_budget, dbar.dtype)
+        # node_ascent is a static handful of sequential ascent steps
+        # (default 2) — unrolling is intended  # graftlint: disable=R4
         for _ in range(node_ascent):
             g = jnp.where(in_s, deg - target, 0).astype(dbar.dtype)
             # the clamp bounds lambda drift to the magnitude headroom
@@ -1441,14 +1448,15 @@ class _Reservoir:
         an empty device stack, dropping nodes the incumbent has since
         closed. ``capacity`` is the logical slot count, REQUIRED — the
         buffer's own row count includes push-padding rows and would
-        over-fill (eroding the spill-headroom invariant)."""
-        host = np.asarray(fr.nodes).copy()
-        take = self.refill_host(host, capacity, inc_cost, integral)
-        if take == 0:
+        over-fill (eroding the spill-headroom invariant). The stack is
+        empty (count 0), so nothing is fetched: the refilled rows are
+        written in place over the dead buffer with a sliced device write."""
+        keep = self._partition(None, inc_cost, integral, capacity)
+        if keep is None:
             return fr
-        return Frontier(
-            jnp.asarray(host), jnp.asarray(take, jnp.int32), fr.overflow
-        )
+        take = keep.shape[0]
+        nodes = fr.nodes.at[:take].set(jnp.asarray(keep))
+        return Frontier(nodes, jnp.asarray(take, jnp.int32), fr.overflow)
 
     def _partition(self, extra, inc_cost, integral, capacity: int):
         """Shared core of exchange/refill: merge ``extra`` rows (may be
@@ -1460,6 +1468,8 @@ class _Reservoir:
         self.chunks = []
         chunks = [c for c in chunks if c.shape[0]]
         if not chunks:
+            # nothing to drop: only empty chunks existed, so this early
+            # return loses no state  # graftlint: disable=R5
             return None
         merged = chunks[0] if len(chunks) == 1 else np.concatenate(chunks)
         bounds = _np_bound_col(merged)
@@ -1469,6 +1479,13 @@ class _Reservoir:
         m = merged.shape[0]
         take = min(m, capacity // 2)
         if take == 0:
+            if m:
+                # capacity < 2 leaves zero on-device slots: the alive rows
+                # must stay SPILLED, not vanish — self.chunks was cleared
+                # above, so dropping ``merged`` here would discard open
+                # nodes and let a degenerate run claim proven_optimal with
+                # subtrees unexplored (ADVICE r5 item 1)
+                self.chunks.append(merged)
             return None
         if take < m:
             sel = np.argpartition(bounds, take - 1)[:take]
@@ -1506,9 +1523,15 @@ class _Reservoir:
         nodes legitimately stay spilled; the LB lag is at most one
         exchange period.
         """
+        _contracts.check_frontier(fr, where="_Reservoir.exchange")
         cnt = int(fr.count)
-        host = np.asarray(fr.nodes).copy()
-        live = host[:cnt].copy()
+        # transfer ONLY the live prefix: the physical buffer carries
+        # capacity + k*n push-padding rows (~hundreds of MB at kroA100
+        # scale) and every row past ``count`` is dead — round-tripping the
+        # whole buffer down and back up on every spill was ADVICE r5
+        # item 3. The .copy() decouples from any zero-copy CPU-backend
+        # view so rows stored in the reservoir never pin the old buffer.
+        live = np.asarray(fr.nodes[:cnt]).copy()  # graftlint: disable=R1 — the one minimal per-spill fetch
         lb = _np_bound_col(live)
         alive_lb = lb[lb <= inc_cost - 1.0] if integral else lb[lb < inc_cost]
         live_min = float(alive_lb.min()) if alive_lb.size else float("inf")
@@ -1518,12 +1541,13 @@ class _Reservoir:
             keep = self._keep_live_only(live, inc_cost, integral, capacity)
         else:
             keep = self._partition(live, inc_cost, integral, capacity)
-        take = 0 if keep is None else keep.shape[0]
-        if take:
-            host[:take] = keep
-        return Frontier(
-            jnp.asarray(host), jnp.asarray(take, jnp.int32), fr.overflow
-        )
+        if keep is None:
+            return Frontier(fr.nodes, jnp.asarray(0, jnp.int32), fr.overflow)
+        # upload only the kept slice, written in place — rows past ``take``
+        # are dead (``count`` is authoritative), so nothing else moves
+        take = keep.shape[0]
+        nodes = fr.nodes.at[:take].set(jnp.asarray(keep))
+        return Frontier(nodes, jnp.asarray(take, jnp.int32), fr.overflow)
 
     def _keep_live_only(self, live, inc_cost, integral, capacity: int):
         """exchange()'s fast path (global alive minimum is on-device):
@@ -1549,10 +1573,6 @@ class _Reservoir:
         host[: keep.shape[0]] = keep
         return keep.shape[0]
 
-    def refill_host(self, host: np.ndarray, capacity: int, inc_cost, integral) -> int:
-        """In-place numpy variant of ``refill``; host rows must be empty
-        (count 0). Returns the new count."""
-        return self.exchange_host(host, 0, inc_cost, integral, capacity)
 
 
 def make_root_frontier(
@@ -1838,6 +1858,7 @@ def solve(
         inc_tour = jnp.asarray(inc_tour_np, jnp.int32)
         fr = make_root_frontier(n, capacity, min_out_np, pad_rows=k * n)
 
+    _contracts.check_frontier(fr, n=n, where="solve")
     headroom = _spill_headroom(capacity, inner_steps, k, n)
     t0 = time.perf_counter()
     setup_s = t0 - t_setup
@@ -1895,7 +1916,8 @@ def solve(
                     + (int(best_step) + 1) / max(int(steps), 1) * disp_s
                 )
             it += max(int(steps), 1)
-            if bool(np.asarray(fr.overflow)):
+            # one scalar flag readback per dispatch, not per step
+            if bool(np.asarray(fr.overflow)):  # graftlint: disable=R1
                 # exactness already lost in-kernel (unreachable unless the
                 # capacity guard was bypassed); stop instead of spinning
                 # no-op dispatches — proven_optimal will report False
@@ -2059,8 +2081,9 @@ def solve_sharded(
     used to test that balancing works.
     """
     t_setup = time.perf_counter()
-    from jax import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..utils.backend import pcast_varying, shard_map
 
     from ..parallel.mesh import RANK_AXIS
 
@@ -2159,6 +2182,7 @@ def solve_sharded(
             np.broadcast_to(inc_tour_np, (num_ranks, n + 1)).copy(), spec
         )
 
+    _contracts.check_frontier(fr, n=n, where="solve_sharded")
     t_slots = int(transfer) if transfer is not None else max(k, 64)
     t_slots = min(t_slots, capacity_per_rank // 4)
     perm_fwd = [(r, (r + 1) % num_ranks) for r in range(num_ranks)]
@@ -2355,9 +2379,8 @@ def solve_sharded(
             total = jax.lax.psum(fr.count, RANK_AXIS)
             # psum/all-reduce results are axis-invariant; the carry slot was
             # initialized from a varying value, so re-mark it varying
-            done = jax.lax.pcast(
-                (total == 0) | any_stop, RANK_AXIS, to="varying"
-            )
+            # (identity on jax builds without VMA tracking — backend compat)
+            done = pcast_varying((total == 0) | any_stop, RANK_AXIS)
             return fr, icc, itc, nds + dn, i + 1, done
 
         zero = local.count * 0

@@ -46,7 +46,7 @@ def _relax_kernel(g_ref, dt_ref, cost_ref, parent_ref, *, m: int):
     cost_ref / parent_ref: [TJ, 128] outputs (columns >= m are scratch)
     """
     g = g_ref[:]
-    for k in range(m):  # static unroll: m-1 <= 16 iterations
+    for k in range(m):  # static unroll: m-1 <= 16 iterations  # graftlint: disable=R4
         cand = g + dt_ref[k, :][None, :]
         cost_ref[:, k] = jnp.min(cand, axis=1)
         parent_ref[:, k] = jnp.argmin(cand, axis=1).astype(jnp.int32)
@@ -141,7 +141,7 @@ def _relax_dense_kernel(
     cost = cost_ref[:]
     mask_row = mask2d[0]
     upd_c = popc == c  # [tile] masks of this cardinality
-    for k in range(m):  # static unroll, <= 17 rows
+    for k in range(m):  # static unroll, <= 17 rows  # graftlint: disable=R4
         cand = g + dsub_ref[:, k][:, None]
         mn = jnp.min(cand, axis=0)  # [tile]
         upd = upd_c & (((mask_row >> k) & 1) == 0)  # endpoint k outside mask

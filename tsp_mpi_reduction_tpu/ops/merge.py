@@ -40,6 +40,8 @@ from typing import NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 
+from ..analysis import contracts
+
 
 class PaddedTour(NamedTuple):
     """A closed tour in a fixed-size buffer.
@@ -93,6 +95,8 @@ def merge_tours(t1: PaddedTour, t2: PaddedTour, dist: jnp.ndarray) -> PaddedTour
     Caller must guarantee ``t1.length + t2.length - 1 <= P1`` and both
     operands hold >= 3 distinct cities (see module docstring).
     """
+    contracts.check_padded_tour(t1, where="merge_tours.t1")
+    contracts.check_padded_tour(t2, where="merge_tours.t2")
     a, b, r1, r2 = _tour_edges(t1, t2)
     # swapPairCost (tsp.cpp:197-200), left-to-right addition order:
     # ((d(a, r2) + d(b, r1)) - d(a, b)) - d(r1, r2)
@@ -161,7 +165,11 @@ def make_padded(ids, length, cost, capacity: int) -> PaddedTour:
     buf = jnp.pad(ids, (0, pad))
     lane = jnp.arange(capacity)
     buf = jnp.where(lane < length, buf, 0)
-    return PaddedTour(buf, jnp.asarray(length, jnp.int32), cost)
+    return contracts.check_padded_tour(
+        PaddedTour(buf, jnp.asarray(length, jnp.int32), cost),
+        capacity=capacity,
+        where="make_padded",
+    )
 
 
 def fold_tours(

@@ -42,10 +42,11 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
+from ..analysis import contracts
 from ..ops.merge import PaddedTour, merge_tours
+from ..utils.backend import shard_map
 from .mesh import RANK_AXIS
 
 
@@ -158,10 +159,13 @@ def reduce_tours_on_mesh(
     """
     num_ranks = mesh.devices.size
     schedule = tree_schedule(num_ranks)
+    _check_reduce_inputs(tours, costs, valid, dist, capacity, num_ranks)
 
     def body(tours_blk, costs_blk, valid_blk, dist_rep):
         acc = _local_fold(tours_blk, costs_blk, valid_blk, dist_rep, capacity)
-        for _name, pairs in schedule:
+        # the tree schedule is static and log2(p) rounds deep — unrolling
+        # IS the reduction; each round's ppermute pairs differ, so no scan
+        for _name, pairs in schedule:  # graftlint: disable=R4
             recv = jax.tree.map(
                 lambda x: jax.lax.ppermute(x, RANK_AXIS, pairs), acc
             )
@@ -176,6 +180,40 @@ def reduce_tours_on_mesh(
     )(tours, costs, valid, dist)
     ids, length, cost = out
     return ids[0], length[0], cost[0]
+
+
+def _check_reduce_inputs(tours, costs, valid, dist, capacity, num_ranks):
+    """Boundary contract for the mesh/rank-emulated reductions: the shard
+    layout assumptions below are silent data corruption when violated
+    (rows land on the wrong rank; a short capacity truncates the splice)."""
+    if contracts.level() == "off":
+        return
+    if tours.ndim != 2:
+        raise contracts.ContractError(
+            f"reduce: tours must be [P*K, L] block tours, got {tours.shape}"
+        )
+    pk, l = tours.shape
+    if costs.shape != (pk,) or valid.shape != (pk,):
+        raise contracts.ContractError(
+            f"reduce: costs {costs.shape} / valid {valid.shape} must both be "
+            f"[{pk}] to match the {pk} block rows"
+        )
+    if pk % num_ranks:
+        raise contracts.ContractError(
+            f"reduce: {pk} block rows not divisible by {num_ranks} ranks"
+        )
+    if dist.ndim != 2 or dist.shape[0] != dist.shape[1]:
+        raise contracts.ContractError(
+            f"reduce: dist must be a square [N, N] matrix, got {dist.shape}"
+        )
+    if valid.dtype != jnp.bool_:
+        raise contracts.ContractError(
+            f"reduce: valid must be bool, got {valid.dtype}"
+        )
+    if capacity < l:
+        raise contracts.ContractError(
+            f"reduce: capacity {capacity} below block tour length {l}"
+        )
 
 
 def pmin_incumbent(value: jnp.ndarray, axis_name: str = RANK_AXIS) -> jnp.ndarray:
@@ -235,7 +273,11 @@ def tree_reduce_single_device(
     """
     pk, l = tours.shape
     if pk % num_ranks:
+        # hard precondition for the reshape below, NOT an optional
+        # contract: must hold (with a targeted error) even under
+        # TSP_CONTRACTS=off, where _check_reduce_inputs is a no-op
         raise ValueError(f"{pk} block slots not divisible by {num_ranks} ranks")
+    _check_reduce_inputs(tours, costs, valid, dist, capacity, num_ranks)
     k = pk // num_ranks
     tours_r = tours.reshape(num_ranks, k, l)
     costs_r = costs.reshape(num_ranks, k)
@@ -249,6 +291,8 @@ def tree_reduce_single_device(
     if compat_bugs:
         acc_ids = jnp.zeros((num_ranks, capacity), jnp.int32)
         acc_len = jnp.zeros(num_ranks, jnp.int32)
+    # static log2(p)-round tree, one vmapped merge per round (see body()
+    # above) — the unroll is the algorithm  # graftlint: disable=R4
     for _name, pairs in tree_schedule(num_ranks):
         src = jnp.asarray([s for s, _ in pairs])
         dst = jnp.asarray([d for _, d in pairs])

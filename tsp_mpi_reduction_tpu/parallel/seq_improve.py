@@ -47,7 +47,8 @@ def ring_two_opt(
     irrelevant for a closed tour).
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
-    from jax import shard_map
+
+    from ..utils.backend import shard_map
 
     n = int(tour.shape[0])
     num_ranks = int(mesh.devices.size)

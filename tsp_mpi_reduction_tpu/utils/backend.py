@@ -11,6 +11,31 @@ from __future__ import annotations
 
 import jax
 
+try:  # jax >= 0.5 re-exports shard_map at the top level
+    from jax import shard_map  # noqa: F401  (compat re-export)
+except ImportError:  # older jax: experimental home, check_rep still on
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+    def shard_map(f, /, **kwargs):  # noqa: F401  (compat re-export)
+        # the experimental version's check_rep=True has no replication
+        # rule for lax.while_loop (used by the 2-opt sweep bodies); the
+        # top-level export this repo targets doesn't check, so match it
+        kwargs.setdefault("check_rep", False)
+        return _shard_map_impl(f, **kwargs)
+
+def pcast_varying(x, axis_name: str):
+    """Mark a cross-rank-invariant value as varying over ``axis_name``.
+
+    ``jax.lax.pcast(..., to="varying")`` only exists on jax builds with
+    varying-manual-axes (VMA) tracking; older builds don't track VMA under
+    ``check_rep=False`` shard_map, so the cast is the identity there.
+    """
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is None:
+        return x
+    return pcast(x, axis_name, to="varying")
+
+
 ACCELERATOR_PLATFORMS = ("tpu", "axon")
 #: Out-of-tree remote plugins whose factory init dials a network tunnel (and
 #: can hang). Builtin platforms ("tpu") must never be deregistered: jax's
