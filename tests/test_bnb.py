@@ -733,6 +733,147 @@ def test_exchange_transfers_live_prefix_only():
     assert int(out3.count) == 0 and out3.nodes is dead.nodes
 
 
+def test_exchange_rows_fast_full_equivalence():
+    """PR 2 property test: the exchange fast path (live-only best-half
+    select, reservoir untouched) and the full merge must be EQUIVALENT in
+    what survives — the global multiset of alive bounds (device keep +
+    reservoir) equals the alive input multiset, so the certified minimum
+    is identical — across randomized frontiers, reservoirs, incumbents
+    and capacities including the degenerate capacity<=1 / take==0 edges
+    fixed in PR 1. They may split the survivors differently (that is the
+    point: the fast path skips the reservoir concatenate), but neither
+    may drop an open node or resurrect a closed one."""
+    rng = np.random.default_rng(7)
+    n = 6
+    inc = 50.0
+    for trial in range(60):
+        capacity = int(rng.choice([1, 2, 3, 5, 8, 16, 64]))
+        n_live = int(rng.integers(0, 13))
+        live_b = np.round(rng.uniform(0, 100, n_live).astype(np.float32), 2)
+        chunk_bounds = [
+            np.round(rng.uniform(0, 100, int(rng.integers(0, 7))).astype(np.float32), 2)
+            for _ in range(int(rng.integers(0, 4)))
+        ]
+        alive_in = sorted(
+            float(b)
+            for arr in [live_b] + chunk_bounds
+            for b in arr
+            if b < inc
+        )
+
+        outs = {}
+        for merge in (False, True):
+            rv = bb._Reservoir()
+            for cb in chunk_bounds:
+                if cb.size:
+                    rv.chunks.append(_packed_rows(n, cb))
+            live = _packed_rows(n, live_b) if n_live else np.zeros(
+                (0, n + 1 + 4), np.int32
+            )
+            keep = rv.exchange_rows(live, inc, False, capacity, merge=merge)
+            kept_b = (
+                [] if keep is None
+                else bb._np_bound_col(keep).astype(float).tolist()
+            )
+            res_b = [
+                float(b) for c in rv.chunks for b in bb._np_bound_col(c)
+            ]
+            outs[merge] = (kept_b, res_b)
+            # the kept slice never exceeds the best-half budget and holds
+            # only alive rows
+            assert len(kept_b) <= capacity // 2
+            assert all(b < inc for b in kept_b)
+            if merge:
+                # the full merge also drops closed reservoir rows, so its
+                # surviving multiset is exactly the alive inputs
+                assert sorted(kept_b + res_b) == alive_in, (trial, merge)
+            else:
+                # fast path: alive survivors identical; dead reservoir
+                # rows may additionally linger until the next prune/merge
+                alive_out = sorted(b for b in kept_b + res_b if b < inc)
+                assert alive_out == alive_in, (trial, merge)
+        if alive_in:
+            # identical certified minimum over the open set either way
+            for merge, (kept_b, res_b) in outs.items():
+                alive_out = [b for b in kept_b + res_b if b < inc]
+                assert min(alive_out) == alive_in[0], (trial, merge)
+
+
+def test_sharded_spill_counters_fast_path():
+    """Acceptance: the sharded fast path transfers only live-prefix bytes.
+    A spill-heavy sharded run must (a) still prove, (b) record spill
+    traffic strictly below the pre-PR-2 full-buffer round trip, (c) bound
+    the host-ward bytes by live-prefix size (<= capacity rows per event —
+    never the physical buffer with its k*n padding rows), and (d) take
+    the full reservoir merge only on a minority of events (the inversion
+    case), not every spill (ADVICE r5 item 2)."""
+    d = np.rint(random_d(13, 51) * 10)
+    hk, _ = solve_blocks_from_dists(d[None])
+    ranks, cap, k, n = 4, 128, 4, 13
+    res = bb.solve_sharded(
+        d, make_rank_mesh(ranks), capacity_per_rank=cap, k=k, inner_steps=1,
+        bound="min-out", mst_prune=False, node_ascent=0, max_iters=2_000_000,
+    )
+    assert res.proven_optimal and res.cost == float(hk[0])
+    assert res.spill_rounds > 0 and res.spill_events >= res.spill_rounds
+    width = n + 1 + 4
+    live_prefix_cap = res.spill_events * cap * width * 4
+    phys_roundtrip = res.spill_rounds * 2 * ranks * (cap + k * n) * width * 4
+    assert 0 < res.spill_bytes_to_host <= live_prefix_cap
+    assert 0 < res.spill_bytes_to_device <= live_prefix_cap
+    total = res.spill_bytes_to_host + res.spill_bytes_to_device
+    assert total < phys_roundtrip  # strictly beats HEAD's full round trip
+    assert res.spill_full_merges < res.spill_events  # fast path dominates
+
+
+def test_lb_certified_monotone_across_resumed_chunks(tmp_path):
+    """Satellite: the reported certified LB must never regress across a
+    chunked (checkpoint/resume) campaign — each chunk's lower_bound is
+    clamped to the running max the checkpoint carries; lb_raw stays the
+    chunk's own min-over-open value (<= the certified one)."""
+    d = np.rint(random_d(12, 33) * 10)
+    ck = str(tmp_path / "mono.npz")
+    kw = dict(capacity=1 << 13, k=8, inner_steps=1, bound="min-out",
+              mst_prune=False, node_ascent=0, device_loop=False)
+    res = bb.solve(d, max_iters=3, checkpoint_path=ck, **kw)
+    assert not res.proven_optimal
+    assert res.lower_bound >= res.lower_bound_raw
+    prev = res.lower_bound
+    for _ in range(4):
+        res = bb.solve(d, max_iters=3, resume_from=ck, checkpoint_path=ck,
+                       **kw)
+        assert res.lower_bound >= prev  # monotone, chunk over chunk
+        assert res.lower_bound >= res.lower_bound_raw
+        assert res.lower_bound <= res.cost
+        prev = res.lower_bound
+        if res.proven_optimal:
+            break
+    # the checkpoint itself carries the certified floor
+    if not res.proven_optimal:
+        *_, lb0 = bb.restore(ck, expect_d=d, expect_bound="min-out")
+        assert lb0 == pytest.approx(res.lower_bound)
+
+
+def test_sharded_lb_certified_monotone(tmp_path):
+    """The sharded engine honors the same certified-LB floor contract."""
+    d = np.rint(random_d(12, 34) * 10)
+    mesh = make_rank_mesh(4)
+    ck = str(tmp_path / "mono_shard.npz")
+    kw = dict(capacity_per_rank=1 << 11, k=8, inner_steps=1,
+              bound="min-out", mst_prune=False, node_ascent=0)
+    res = bb.solve_sharded(d, mesh, max_iters=2, checkpoint_path=ck, **kw)
+    assert not res.proven_optimal
+    prev = res.lower_bound
+    for _ in range(3):
+        res = bb.solve_sharded(d, mesh, max_iters=2, resume_from=ck,
+                               checkpoint_path=ck, **kw)
+        assert res.lower_bound >= prev
+        assert res.lower_bound >= res.lower_bound_raw
+        prev = res.lower_bound
+        if res.proven_optimal:
+            break
+
+
 def test_degenerate_capacity_run_stays_honest():
     """Degenerate-config regression for the take==0 fix: at capacity 1-2
     (capacity//2 <= 1) the engine crawls through the reservoir one node

@@ -651,3 +651,109 @@ def test_contract_error_is_a_value_error():
     exit 2 — contract failures must flow through that path, not escape
     as raw tracebacks."""
     assert issubclass(contracts.ContractError, ValueError)
+
+
+# -- PR 2: drained baseline, stale-debt detector, exchange contracts ----------
+
+def test_baseline_is_drained_and_never_grows():
+    """The ratchet: PR 2 drained the graftlint baseline to ZERO entries
+    (the sharded spill_refill debt was the last). New hot-path violations
+    must be fixed, not baselined — this assertion makes the invariant
+    permanent."""
+    from tsp_mpi_reduction_tpu.analysis.__main__ import _DEFAULT_BASELINE
+
+    baseline = graftlint.load_baseline(_DEFAULT_BASELINE)
+    assert sum(baseline.values()) == 0, (
+        "graftlint_baseline.json grew again — fix the violation instead of "
+        f"re-accepting debt: {sorted(baseline)}"
+    )
+
+
+def test_collect_scopes_qualified_names():
+    import ast
+
+    tree = ast.parse(textwrap.dedent(
+        """
+        def solve_sharded():
+            def spill_refill():
+                pass
+
+        class _Reservoir:
+            def exchange(self):
+                pass
+        """
+    ))
+    scopes = graftlint.collect_scopes(tree)
+    assert {"<module>", "solve_sharded", "solve_sharded.spill_refill",
+            "_Reservoir", "_Reservoir.exchange"} <= scopes
+    assert "exchange" not in scopes  # only the qualified name exists
+
+
+def test_find_dead_scopes_detects_gone_code(tmp_path):
+    """A baseline entry whose scope vanished from the source is DEAD debt
+    — it can never be repaid and must fail the gate; entries whose scope
+    still exists are left alone (they may just be stale text)."""
+    mod = tmp_path / "engine.py"
+    mod.write_text("def keeper():\n    pass\n")
+    baseline = {
+        "engine.py::R1::keeper::x = 1": 1,           # scope alive
+        "engine.py::R1::vanished.inner::y = 2": 1,   # scope gone
+        "missing.py::R2::whatever::z = 3": 1,        # file gone
+        "not-a-fingerprint": 1,                      # unparseable
+    }
+    dead = graftlint.find_dead_scopes(baseline, tmp_path)
+    assert dead == sorted([
+        "engine.py::R1::vanished.inner::y = 2",
+        "missing.py::R2::whatever::z = 3",
+        "not-a-fingerprint",
+    ])
+
+
+def test_cli_fails_on_dead_baseline_entry(tmp_path, capsys):
+    """`make lint` must go red when the baseline carries debt for code
+    that no longer exists (the stale-debt detector satellite)."""
+    src = tmp_path / "clean.py"
+    src.write_text("def f():\n    return 1\n")
+    bl = tmp_path / "baseline.json"
+    bl.write_text(
+        '{"version": 1, "entries": {"'
+        + str(src) + '::R1::gone_scope::x = np.asarray(fr.nodes)": 1}}'
+    )
+    rc = graftlint_main([str(src), "--baseline", str(bl)])
+    assert rc == 1
+    assert "DEAD baseline entry" in capsys.readouterr().out
+
+
+def test_fetch_live_rows_is_a_default_hot_path():
+    """The one accepted transfer site must stay under lint surveillance:
+    it is hot by default, so an UN-waived pull added there still fires."""
+    assert "_fetch_live_rows" in graftlint.DEFAULT_HOT_PATHS
+    vs = lint(
+        """
+        import numpy as np
+
+        def _fetch_live_rows(fr, cnt):
+            extra = np.asarray(fr.nodes)
+            return np.asarray(fr.nodes[:cnt]).copy()  # graftlint: disable=R1
+        """
+    )
+    assert rules_of(vs) == ["R1"]  # the waived line is quiet, the new pull is not
+
+
+def test_check_exchange_count_bounds():
+    """The sharded exchange boundary contract: kept counts outside
+    [0, capacity // 2] must fail (they re-arm the overflow pressure the
+    reservoir exists to shed)."""
+    assert contracts.check_exchange_count(0, 1) == 0
+    assert contracts.check_exchange_count(4, 8) == 4
+    with pytest.raises(contracts.ContractError, match="outside"):
+        contracts.check_exchange_count(5, 8)
+    with pytest.raises(contracts.ContractError, match="outside"):
+        contracts.check_exchange_count(-1, 8)
+    with pytest.raises(contracts.ContractError, match="outside"):
+        contracts.check_exchange_count(1, 1)  # capacity//2 == 0 keeps nothing
+
+
+def test_check_exchange_count_off_level(monkeypatch):
+    monkeypatch.setenv("TSP_CONTRACTS", "off")
+    assert contracts.check_exchange_count(999, 4) == 999

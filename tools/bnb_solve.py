@@ -219,13 +219,29 @@ def main() -> int:
                 "balance": args.balance if args.ranks > 1 else None,
                 "root_lower_bound": round(res.root_lower_bound, 3),
                 # final certified LB (min over still-open nodes; = cost when
-                # proven) — the honest gap after the search, not the root's
+                # proven) — the honest gap after the search, not the root's.
+                # lb_raw is THIS chunk's un-clamped value; lb_certified (==
+                # lower_bound) is clamped to the running max carried through
+                # the checkpoint, so it is monotone across chunked resumes
                 "lower_bound": round(res.lower_bound, 3),
+                "lb_raw": (
+                    round(res.lower_bound_raw, 3)
+                    if res.lower_bound_raw > -1e30
+                    else None
+                ),
+                "lb_certified": round(res.lower_bound, 3),
                 "gap": (
                     round(res.cost - res.lower_bound, 3)
                     if res.lower_bound > -1e30
                     else None
                 ),
+                # reservoir transfer accounting (SpillStats): proof that
+                # spills move live-prefix bytes only, measured not asserted
+                "spill_rounds": res.spill_rounds,
+                "spill_events": res.spill_events,
+                "spill_full_merges": res.spill_full_merges,
+                "spill_bytes_to_host": res.spill_bytes_to_host,
+                "spill_bytes_to_device": res.spill_bytes_to_device,
             }
         )
     )

@@ -15,6 +15,7 @@ import sys
 from .graftlint import (
     RULES,
     apply_baseline,
+    find_dead_scopes,
     lint_paths,
     load_baseline,
     write_baseline,
@@ -104,18 +105,30 @@ def main(argv=None) -> int:
 
     baseline = {} if args.no_baseline else load_baseline(args.baseline)
     res = apply_baseline(violations, baseline)
+    # stale-DEBT detector: a baseline entry whose file/scope is gone from
+    # the source can never be repaid — it only masks a future violation
+    # that happens to reuse the fingerprint. Fail, don't warn. A dead
+    # entry necessarily also matched no violation, so drop it from the
+    # (warn-only) stale list — one entry, one verdict.
+    dead = find_dead_scopes(baseline, _REPO_ROOT)
+    stale = [fp for fp in res.stale if fp not in set(dead)]
 
     if not args.quiet:
         for v in res.new:
             print(v.render())
-        for fp in res.stale:
+        for fp in stale:
             print(f"graftlint: stale baseline entry (fixed? regenerate): {fp}")
+    for fp in dead:
+        print(
+            "graftlint: DEAD baseline entry (scope gone from source — "
+            f"delete it or regenerate the baseline): {fp}"
+        )
     print(
         f"graftlint: {len(res.new)} new, {len(res.accepted)} baselined, "
-        f"{len(res.stale)} stale baseline entries "
+        f"{len(stale)} stale, {len(dead)} dead baseline entries "
         f"({len(targets)} target(s), rules {','.join(sorted(rules))})"
     )
-    return 1 if res.new else 0
+    return 1 if (res.new or dead) else 0
 
 
 if __name__ == "__main__":

@@ -119,6 +119,27 @@ def check_frontier(fr, *, n: Optional[int] = None, where: str = ""):
     return fr
 
 
+def check_exchange_count(count, capacity: int, *, where: str = ""):
+    """Value-level contract on a reservoir exchange/refill boundary: the
+    kept (on-device) row count must lie in ``[0, capacity // 2]`` — the
+    best-half invariant every reservoir path promises. A count above it
+    re-arms exactly the capacity pressure the reservoir exists to shed
+    (the next inner batch could overflow-drop children); a negative one
+    corrupts every downstream masked scan. Host ints only — no device
+    sync — so it stays on at the default level.
+    """
+    if level() == "off":
+        return count
+    lim = max(capacity // 2, 0)
+    if not 0 <= int(count) <= lim:
+        _fail(
+            where,
+            f"exchange kept {int(count)} rows, outside [0, {lim}] "
+            f"(capacity {capacity})",
+        )
+    return count
+
+
 def check_padded_tour(t, *, capacity: Optional[int] = None, where: str = ""):
     """Validate a PaddedTour's structural invariants; returns ``t``.
 

@@ -74,6 +74,9 @@ DEFAULT_HOT_PATHS = frozenset(
         "refill",
         "_keep_live_only",
         "spill_refill",
+        "_fetch_live_rows",  # the ONE accepted live-prefix fetch site
+        "_apply_keeps",
+        "_rank_counts",
         "_expand_loop",
     }
 )
@@ -717,3 +720,52 @@ def apply_baseline(
             res.new.append(v)
     res.stale = sorted(k for k, n in budget.items() if n > 0)
     return res
+
+
+def collect_scopes(tree: ast.Module) -> Set[str]:
+    """Every qualified def/class scope a module defines, dotted exactly as
+    ``_FileLinter`` qualifies violation scopes ("Cls.meth",
+    "solve_sharded.spill_refill", ...), plus "<module>"."""
+    out: Set[str] = {"<module>"}
+
+    def walk(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                q = f"{prefix}.{child.name}" if prefix else child.name
+                out.add(q)
+                walk(child, q)
+            else:
+                walk(child, prefix)
+
+    walk(tree, "")
+    return out
+
+
+def find_dead_scopes(baseline: Dict[str, int], root: pathlib.Path) -> List[str]:
+    """Baseline fingerprints whose file or enclosing scope no longer exists
+    in the source — stale DEBT, not just stale line numbers: the code the
+    entry was accepted for is gone, so the entry can never be repaid and
+    only masks a future violation that happens to reuse the fingerprint.
+    ``make lint`` fails on these (delete the entry or regenerate the
+    baseline). Fingerprints are ``path::rule::scope::code``; each
+    referenced file is parsed once."""
+    scopes_by_path: Dict[str, Optional[Set[str]]] = {}
+    dead: List[str] = []
+    for fp in baseline:
+        parts = fp.split("::", 3)
+        if len(parts) != 4:
+            dead.append(fp)  # unparseable fingerprint: treat as dead debt
+            continue
+        path, _rule, scope, _code = parts
+        if path not in scopes_by_path:
+            try:
+                source = (root / path).read_text()
+                scopes_by_path[path] = collect_scopes(ast.parse(source))
+            except (OSError, SyntaxError, UnicodeDecodeError):
+                scopes_by_path[path] = None
+        known = scopes_by_path[path]
+        if known is None or scope not in known:
+            dead.append(fp)
+    return sorted(dead)

@@ -188,6 +188,29 @@ def _pack_rows_np(path, mask, depth, cost, bound, sum_min) -> np.ndarray:
 
 
 @dataclass
+class SpillStats:
+    """Per-solve reservoir transfer accounting — the evidence that spills
+    move only live-prefix bytes (ADVICE r5 items 2-3), surfaced through
+    ``BnBResult`` and the bench/driver JSON so the invariant is measured,
+    not asserted.
+
+    ``rounds`` counts spill/refill synchronization points (one per
+    ``spill_refill`` call that did work, or per single-device
+    exchange/refill); ``events`` counts per-rank exchanges within them.
+    ``full_merges`` counts the events that actually concatenated the host
+    reservoir (the slow path — only taken when the reservoir owns the
+    rank's alive minimum). The byte counters measure actual host<->device
+    traffic: live-prefix fetches down, kept-slice writes up.
+    """
+
+    rounds: int = 0
+    events: int = 0
+    full_merges: int = 0
+    bytes_to_host: int = 0
+    bytes_to_device: int = 0
+
+
+@dataclass
 class BnBResult:
     cost: float
     tour: np.ndarray  # [n+1] closed tour of city indices, starts/ends at 0
@@ -220,6 +243,19 @@ class BnBResult:
     #: ils — is backend/compile overhead, the actionable part on TPU
     ascent_seconds: float = 0.0
     ils_seconds: float = 0.0
+    #: min bound over this RUN SEGMENT's still-open nodes alone (the
+    #: un-clamped value); ``lower_bound`` is clamped to the running max
+    #: across resumed chunks (the checkpoint carries the floor), so the
+    #: reported certified LB is monotone over a chunked campaign
+    lower_bound_raw: float = -np.inf
+    #: reservoir transfer accounting (see SpillStats): spill/refill sync
+    #: rounds, per-rank exchange events, full reservoir merges among them,
+    #: and the actual bytes moved host-ward/device-ward by those events
+    spill_rounds: int = 0
+    spill_events: int = 0
+    spill_full_merges: int = 0
+    spill_bytes_to_host: int = 0
+    spill_bytes_to_device: int = 0
 
 
 def nearest_neighbor_tour(d: np.ndarray, start: int = 0) -> np.ndarray:
@@ -1402,6 +1438,21 @@ def _np_bound_col(rows: np.ndarray) -> np.ndarray:
     return np.ascontiguousarray(rows[..., n + w + 2]).view(np.float32)
 
 
+def _fetch_live_rows(nodes: jnp.ndarray, cnt: int) -> np.ndarray:
+    """The ONE accepted per-spill device->host fetch: only the LIVE PREFIX
+    of a frontier node buffer. Rows past ``count`` are dead, and the
+    physical buffer also carries k*n push-padding rows — hundreds of MB at
+    kroA100 scale, which the pre-PR-2 paths round-tripped whole on every
+    spill (ADVICE r5 items 2-3). Every reservoir path (single-device
+    ``exchange``, the sharded ``spill_refill``) funnels through here so
+    the transfer invariant lives at one site; the function is listed in
+    graftlint's DEFAULT_HOT_PATHS and carries the repo's one explicit R1
+    waiver, marking the accepted transfer exactly where it happens. The
+    ``.copy()`` decouples from any zero-copy CPU-backend view so
+    reservoir rows never pin the device buffer alive."""
+    return np.asarray(nodes[:cnt]).copy()  # graftlint: disable=R1 — the one minimal per-spill fetch
+
+
 class _Reservoir:
     """Host-side overflow store for frontier nodes (packed numpy chunks,
     rows in the Frontier layout).
@@ -1413,8 +1464,11 @@ class _Reservoir:
     discarded by a certified bound check.
     """
 
-    def __init__(self):
+    def __init__(self, stats: Optional[SpillStats] = None):
         self.chunks: list = []  # each: [m, n + W + 4] int32 packed rows
+        #: transfer accounting; solvers share ONE SpillStats across all
+        #: rank reservoirs so BnBResult reports whole-run totals
+        self.stats = stats if stats is not None else SpillStats()
 
     def __len__(self) -> int:
         return sum(int(c.shape[0]) for c in self.chunks)
@@ -1441,6 +1495,12 @@ class _Reservoir:
                 out.append(c[alive])
         self.chunks = out
 
+    def refill_rows(self, inc_cost: float, integral: bool, capacity: int):
+        """Host core of ``refill``: the best-bound ``capacity // 2``
+        reservoir rows (stack order) for an EMPTY device stack, with
+        incumbent-closed nodes dropped; None when nothing survives."""
+        return self._partition(None, inc_cost, integral, capacity)
+
     def refill(
         self, fr: Frontier, inc_cost: float, integral: bool, capacity: int
     ) -> Frontier:
@@ -1451,10 +1511,14 @@ class _Reservoir:
         over-fill (eroding the spill-headroom invariant). The stack is
         empty (count 0), so nothing is fetched: the refilled rows are
         written in place over the dead buffer with a sliced device write."""
-        keep = self._partition(None, inc_cost, integral, capacity)
+        keep = self.refill_rows(inc_cost, integral, capacity)
         if keep is None:
             return fr
         take = keep.shape[0]
+        _contracts.check_exchange_count(take, capacity, where="_Reservoir.refill")
+        self.stats.rounds += 1
+        self.stats.events += 1
+        self.stats.bytes_to_device += keep.nbytes
         nodes = fr.nodes.at[:take].set(jnp.asarray(keep))
         return Frontier(nodes, jnp.asarray(take, jnp.int32), fr.overflow)
 
@@ -1525,29 +1589,47 @@ class _Reservoir:
         """
         _contracts.check_frontier(fr, where="_Reservoir.exchange")
         cnt = int(fr.count)
-        # transfer ONLY the live prefix: the physical buffer carries
-        # capacity + k*n push-padding rows (~hundreds of MB at kroA100
-        # scale) and every row past ``count`` is dead — round-tripping the
-        # whole buffer down and back up on every spill was ADVICE r5
-        # item 3. The .copy() decouples from any zero-copy CPU-backend
-        # view so rows stored in the reservoir never pin the old buffer.
-        live = np.asarray(fr.nodes[:cnt]).copy()  # graftlint: disable=R1 — the one minimal per-spill fetch
+        # transfer ONLY the live prefix (the physical buffer carries
+        # capacity + k*n push-padding rows — hundreds of MB at kroA100
+        # scale; see _fetch_live_rows)
+        live = _fetch_live_rows(fr.nodes, cnt)
         lb = _np_bound_col(live)
         alive_lb = lb[lb <= inc_cost - 1.0] if integral else lb[lb < inc_cost]
         live_min = float(alive_lb.min()) if alive_lb.size else float("inf")
         # compare ALIVE minima: a dead live row below the reservoir's min
         # must not mask a reservoir node that holds the true certified LB
-        if cnt and self.min_bound() >= live_min:
-            keep = self._keep_live_only(live, inc_cost, integral, capacity)
-        else:
-            keep = self._partition(live, inc_cost, integral, capacity)
+        merge = not (cnt and self.min_bound() >= live_min)
+        self.stats.rounds += 1
+        self.stats.events += 1
+        self.stats.full_merges += int(merge)
+        self.stats.bytes_to_host += live.nbytes
+        keep = self.exchange_rows(live, inc_cost, integral, capacity, merge=merge)
         if keep is None:
             return Frontier(fr.nodes, jnp.asarray(0, jnp.int32), fr.overflow)
         # upload only the kept slice, written in place — rows past ``take``
         # are dead (``count`` is authoritative), so nothing else moves
         take = keep.shape[0]
+        _contracts.check_exchange_count(take, capacity, where="_Reservoir.exchange")
+        self.stats.bytes_to_device += keep.nbytes
         nodes = fr.nodes.at[:take].set(jnp.asarray(keep))
         return Frontier(nodes, jnp.asarray(take, jnp.int32), fr.overflow)
+
+    def exchange_rows(
+        self, live: np.ndarray, inc_cost, integral, capacity: int,
+        merge: bool = True,
+    ):
+        """Host core of the exchange, shared by the single-device path and
+        the sharded ``spill_refill``: partition the ``live`` packed rows —
+        plus the whole reservoir when ``merge`` — against the incumbent
+        and return the rows to place on-device (stack order, worst at the
+        bottom), or None when nothing survives. ``merge=False`` is the
+        fast path for the common no-inversion regime (the frontier already
+        holds the alive minimum): best-half-select over the live rows
+        only, the cut joining the reservoir with the (possibly multi-GB)
+        spilled chunks never touched, let alone concatenated."""
+        if merge:
+            return self._partition(live, inc_cost, integral, capacity)
+        return self._keep_live_only(live, inc_cost, integral, capacity)
 
     def _keep_live_only(self, live, inc_cost, integral, capacity: int):
         """exchange()'s fast path (global alive minimum is on-device):
@@ -1558,20 +1640,6 @@ class _Reservoir:
         saved.extend(self.chunks)  # the cut remainder
         self.chunks = saved
         return keep
-
-    def exchange_host(
-        self, host: np.ndarray, count: int, inc_cost, integral,
-        capacity: int,
-    ) -> int:
-        """In-place numpy variant of ``exchange`` (sharded path: the
-        frontier is already a host copy). Returns the new count."""
-        keep = self._partition(
-            host[:count].copy(), inc_cost, integral, capacity
-        )
-        if keep is None:
-            return 0
-        host[: keep.shape[0]] = keep
-        return keep.shape[0]
 
 
 
@@ -1821,11 +1889,14 @@ def solve(
     min_out_np = np.asarray(min_out, np.float64)
 
     ils_s = 0.0
-    reservoir = _Reservoir()
+    spill_stats = SpillStats()
+    reservoir = _Reservoir(stats=spill_stats)
+    lb_floor = -np.inf  # best certified LB carried across resumed chunks
     if resume_from:
-        fr, inc_cost, inc_tour, reservoir = restore(
+        fr, inc_cost, inc_tour, reservoir, lb_floor = restore(
             resume_from, expect_d=d, expect_bound=bound
         )
+        reservoir.stats = spill_stats
         # the restored arrays define the true LOGICAL capacity (buffer
         # rows minus the k*n push padding _expand_step reserves) — the
         # caller's argument must not disarm the spill trigger below (and
@@ -1968,7 +2039,7 @@ def solve(
             and it - last_ckpt >= checkpoint_every
         ):
             save(checkpoint_path, fr, inc_cost, inc_tour, d=d, bound=bound,
-                 reservoir=reservoir)
+                 reservoir=reservoir, lb_floor=max(lb_floor, root_lb))
             last_ckpt = it
         if cnt == 0:
             break
@@ -1984,7 +2055,12 @@ def solve(
         # always leave a resumable snapshot when stopping early (time limit,
         # iteration cap, target reached)
         save(checkpoint_path, fr, inc_cost, inc_tour, d=d, bound=bound,
-             reservoir=reservoir)
+             reservoir=reservoir, lb_floor=max(lb_floor, root_lb))
+    lb_raw = _final_lower_bound(
+        proven, float(inc_cost), root_lb,
+        [np.asarray(fr.bound[: int(fr.count)])], reservoir,
+        overflow=bool(fr.overflow),
+    )
     return BnBResult(
         cost=float(inc_cost),
         tour=np.asarray(inc_tour),
@@ -1995,15 +2071,55 @@ def solve(
         nodes_per_sec=nodes / wall if wall > 0 else 0.0,
         time_to_best=t_best,
         root_lower_bound=root_lb,
-        lower_bound=_final_lower_bound(
-            proven, float(inc_cost), root_lb,
-            [np.asarray(fr.bound[: int(fr.count)])], reservoir,
-            overflow=bool(fr.overflow),
-        ),
+        # clamp to the resumed floor: both are certified, so the max is —
+        # the reported LB can then never regress across chunked resumes
+        lower_bound=min(max(lb_raw, lb_floor), float(inc_cost)),
+        lower_bound_raw=lb_raw,
         setup_seconds=setup_s,
         ascent_seconds=ascent_s,
         ils_seconds=ils_s,
+        spill_rounds=spill_stats.rounds,
+        spill_events=spill_stats.events,
+        spill_full_merges=spill_stats.full_merges,
+        spill_bytes_to_host=spill_stats.bytes_to_host,
+        spill_bytes_to_device=spill_stats.bytes_to_device,
     )
+
+
+def _rank_counts(count) -> np.ndarray:
+    """Host copy of a sharded frontier's per-rank count vector — [R] int32,
+    tens of bytes: the one per-round scalar-class readback the sharded
+    host loop needs (the multi-rank analog of solve()'s ``int(fr.count)``
+    scalar sync)."""
+    return np.asarray(count)
+
+
+def _apply_keeps(
+    fr: Frontier, keeps: dict, new_counts: np.ndarray, spec,
+    stats: SpillStats,
+) -> Frontier:
+    """Write every exchanged rank's kept rows back into the stacked sharded
+    buffer with ONE sliced scatter (rank-index rows, column prefix), plus
+    the [R] count vector. Shorter keeps are zero-padded to the widest one
+    so the write is a single rectangular block: the padded rows land
+    strictly past that rank's new count, i.e. in dead slots every consumer
+    masks out (the same argument as _expand_step's push-padding block
+    write). Only the kept slices ride the host->device path — never the
+    physical buffer, whose untouched ranks keep their device contents
+    bit-for-bit."""
+    nodes = fr.nodes
+    if keeps:
+        ridx = sorted(keeps)
+        mt = max(k.shape[0] for k in keeps.values())
+        block = np.zeros((len(ridx), mt, int(nodes.shape[-1])), np.int32)
+        for i, r in enumerate(ridx):
+            block[i, : keeps[r].shape[0]] = keeps[r]
+        stats.bytes_to_device += block.nbytes
+        nodes = nodes.at[jnp.asarray(ridx, jnp.int32), :mt].set(
+            jnp.asarray(block)
+        )
+    counts_dev = jax.device_put(new_counts.astype(np.int32), spec)
+    return Frontier(nodes, counts_dev, fr.overflow)
 
 
 def _pair_assignment(all_c, round_i, num_ranks: int, t_slots: int):
@@ -2146,8 +2262,9 @@ def solve_sharded(
     spec = NamedSharding(mesh, P(RANK_AXIS))
     resumed_reservoir = None
     ils_s = 0.0
+    lb_floor = -np.inf  # best certified LB carried across resumed chunks
     if resume_from:
-        fr_h, ic_h, itour_h, resumed_reservoir = restore(
+        fr_h, ic_h, itour_h, resumed_reservoir, lb_floor = restore(
             resume_from, expect_d=d, expect_bound=bound, expect_ranks=num_ranks
         )
         fr = Frontier(
@@ -2433,40 +2550,82 @@ def solve_sharded(
     # spill — a rank whose stack nears capacity sheds its worst-bound
     # bottom half to the host; when the whole mesh drains, spilled nodes
     # flow back (incumbent-filtered), so capacity pressure never converts
-    # into the terminal exactness-lost flag
-    reservoirs = [_Reservoir() for _ in range(num_ranks)]
+    # into the terminal exactness-lost flag. All ranks share ONE transfer
+    # accounting object (BnBResult reports whole-run totals).
+    spill_stats = SpillStats()
+    reservoirs = [_Reservoir(stats=spill_stats) for _ in range(num_ranks)]
     if resumed_reservoir is not None and len(resumed_reservoir):
         # a resumed checkpoint's spilled nodes land on rank 0; the ring
         # balance spreads them once they flow back onto the device
+        resumed_reservoir.stats = spill_stats
         reservoirs[0] = resumed_reservoir
     headroom = _spill_headroom(capacity_per_rank, inner_steps, k, n)
+    # the reusable per-rank alive-min collective (parallel.reduce): the
+    # spill fast-path predicate input, computed ON DEVICE so the decision
+    # costs one [R]-floats readback instead of any buffer fetch
+    from ..parallel.reduce import make_rank_alive_min
+
+    rank_alive_min = make_rank_alive_min(mesh, integral=integral)
 
     def spill_refill(fr, inc_best):
-        counts = np.asarray(fr.count)
+        counts = _rank_counts(fr.count)
         spilling = counts > capacity_per_rank - headroom
         refilling = (counts == 0) & np.asarray(
             [len(rv) > 0 for rv in reservoirs]
         )
         if not (spilling.any() or refilling.any()):
-            return fr, counts.sum()
-        # ONE gather of the stacked packed buffer; spill/refill mutate the
-        # host copy in place, then ONE re-upload
-        host = np.asarray(fr.nodes).copy()
+            return fr, int(counts.sum())
+        # the device-resident exchange (this PR's tentpole): per-rank
+        # frontier alive-minima come from the on-device collective; each
+        # affected rank then fetches ONLY its live prefix, best-half
+        # selects on host, and writes back only the kept slice — the
+        # stacked physical buffer (capacity + k*n padding rows per rank)
+        # never round-trips. The full reservoir merge — the only path
+        # that concatenates the (possibly multi-GB) spilled chunks — runs
+        # solely for ranks whose reservoir owns their alive minimum (the
+        # spill inversion _Reservoir.exchange documents); otherwise the
+        # spilled chunks are never touched.
+        live_min = None
+        if spilling.any():  # refill-only rounds never read the minima
+            live_min = np.asarray(
+                rank_alive_min(
+                    fr.bound, fr.count, jnp.asarray(inc_best, jnp.float32)
+                )
+            )
+        spill_stats.rounds += 1
+        keeps = {}
         new_counts = counts.copy()
         for r in range(num_ranks):
-            if spilling[r] or refilling[r]:
-                # exchange, not plain spill/refill: the per-rank global
-                # best-half re-partition prevents the spill inversion
-                # (see _Reservoir.exchange) from pinning the certified LB
-                # in a rank's reservoir
-                new_counts[r] = reservoirs[r].exchange_host(
-                    host[r], int(counts[r]), inc_best, integral,
-                    capacity_per_rank,
+            if not (spilling[r] or refilling[r]):
+                continue
+            rv = reservoirs[r]
+            if refilling[r]:
+                keep = rv.refill_rows(inc_best, integral, capacity_per_rank)
+                if keep is not None:
+                    rv.stats.events += 1
+            else:
+                cnt = int(counts[r])
+                live = _fetch_live_rows(fr.nodes[r], cnt)
+                # compare ALIVE minima, exactly as the single-device
+                # exchange does: merge the reservoir only when it holds a
+                # strictly better open node than the rank's live frontier
+                merge = not (cnt and rv.min_bound() >= float(live_min[r]))
+                rv.stats.events += 1
+                rv.stats.full_merges += int(merge)
+                rv.stats.bytes_to_host += live.nbytes
+                keep = rv.exchange_rows(
+                    live, inc_best, integral, capacity_per_rank, merge=merge
                 )
-        stacked = Frontier(
-            jax.device_put(host, spec),
-            jax.device_put(new_counts.astype(np.int32), spec),
-            fr.overflow,
+            new_counts[r] = 0 if keep is None else keep.shape[0]
+            if keep is not None:
+                keeps[r] = keep
+            _contracts.check_exchange_count(
+                int(new_counts[r]), capacity_per_rank,
+                where="solve_sharded.spill_refill",
+            )
+        stacked = _apply_keeps(fr, keeps, new_counts, spec, spill_stats)
+        _contracts.check_frontier(
+            stacked, n=n, where="solve_sharded.spill_refill"
         )
         return stacked, int(new_counts.sum())
 
@@ -2552,7 +2711,8 @@ def solve_sharded(
             and it - last_ckpt >= checkpoint_every
         ):
             save(checkpoint_path, fr, ic, itour, d=d, bound=bound,
-                 num_ranks=num_ranks, reservoir=_merge_reservoirs(reservoirs))
+                 num_ranks=num_ranks, reservoir=_merge_reservoirs(reservoirs),
+                 lb_floor=max(lb_floor, root_lb))
             last_ckpt = it
         if int(total0) == 0:
             break
@@ -2567,10 +2727,17 @@ def solve_sharded(
     )
     if checkpoint_path and not proven:
         save(checkpoint_path, fr, ic, itour, d=d, bound=bound,
-             num_ranks=num_ranks, reservoir=_merge_reservoirs(reservoirs))
+             num_ranks=num_ranks, reservoir=_merge_reservoirs(reservoirs),
+             lb_floor=max(lb_floor, root_lb))
     counts = np.asarray(fr.count)
     bounds_h = np.asarray(fr.bound)
     merged_res = _merge_reservoirs(reservoirs) or _Reservoir()
+    lb_raw = _final_lower_bound(
+        proven, float(ic[0]), root_lb,
+        [bounds_h[r, : int(counts[r])] for r in range(num_ranks)],
+        merged_res,
+        overflow=overflow,
+    )
     return BnBResult(
         cost=float(ic[0]),
         tour=np.asarray(itour)[0],
@@ -2581,16 +2748,18 @@ def solve_sharded(
         nodes_per_sec=nodes / wall if wall > 0 else 0.0,
         time_to_best=t_best,
         root_lower_bound=root_lb,
-        lower_bound=_final_lower_bound(
-            proven, float(ic[0]), root_lb,
-            [bounds_h[r, : int(counts[r])] for r in range(num_ranks)],
-            merged_res,
-            overflow=overflow,
-        ),
+        # clamped to the resumed floor — monotone across chunked resumes
+        lower_bound=min(max(lb_raw, lb_floor), float(ic[0])),
+        lower_bound_raw=lb_raw,
         nodes_per_rank=rank_nodes,
         setup_seconds=setup_s,
         ascent_seconds=ascent_s,
         ils_seconds=ils_s,
+        spill_rounds=spill_stats.rounds,
+        spill_events=spill_stats.events,
+        spill_full_merges=spill_stats.full_merges,
+        spill_bytes_to_host=spill_stats.bytes_to_host,
+        spill_bytes_to_device=spill_stats.bytes_to_device,
     )
 
 
@@ -2661,6 +2830,7 @@ def save(
     bound=None,
     reservoir=None,
     num_ranks: Optional[int] = None,
+    lb_floor: Optional[float] = None,
 ) -> None:
     """Checkpoint frontier + incumbent (+ instance fingerprint + any
     host-spilled reservoir nodes) to ``.npz``.
@@ -2668,6 +2838,14 @@ def save(
     ``num_ranks``: set for a sharded checkpoint (stacked [R, ...] frontier
     arrays); restore() then refuses to resume it on a different rank count
     (per-rank stacks can't be re-split without re-sorting the search order).
+
+    ``lb_floor``: the caller's best certified lower bound so far (root
+    bound, or a floor restored from an earlier chunk). When set, the
+    checkpoint records ``lb_certified = max(floor, min bound over every
+    still-open node)`` — both operands are certified, so the max is — and
+    resuming solvers clamp their reported LB to it. This is what makes
+    the reported certified LB MONOTONE across a chunked campaign
+    (VERDICT r5: the per-chunk LB used to regress between chunks).
 
     The .npz stores the LOGICAL node fields (path/mask/...), not the
     packed buffer — the format predates the packed layout and stays
@@ -2689,6 +2867,32 @@ def save(
         payload["bound_mode"] = np.asarray(bound)
     if num_ranks is not None:
         payload["num_ranks"] = np.asarray(num_ranks)
+    if lb_floor is not None:
+        # min over open nodes, from the ALREADY-transferred payload (no
+        # extra device work): frontier live prefixes + reservoir chunks.
+        # UNLESS overflow tripped — children were dropped in-kernel, the
+        # surviving open set no longer covers the search space and its min
+        # is NOT a valid bound (same guard as _final_lower_bound): then
+        # only the caller's floor (certified before the loss) is stored.
+        if bool(np.asarray(payload["overflow"]).any()):
+            open_min = float("-inf")
+        else:
+            bnd, cnt = payload["bound"], payload["count"]
+            if cnt.ndim == 0:
+                mins = [bnd[: int(cnt)].min()] if int(cnt) else []
+            else:
+                mins = [
+                    bnd[r, : int(c)].min()
+                    for r, c in enumerate(cnt.tolist())
+                    if int(c)
+                ]
+            if reservoir is not None and len(reservoir):
+                mins.append(reservoir.min_bound())
+            open_min = float(min(mins)) if mins else float("inf")
+        inc = float(np.asarray(inc_cost).reshape(-1)[0])
+        payload["lb_certified"] = np.asarray(
+            min(max(float(lb_floor), open_min), inc)
+        )
     if reservoir is not None and len(reservoir):
         # pure host-side unpack — the reservoir exists precisely because
         # device memory ran out, so it must never round-trip the device
@@ -2700,15 +2904,18 @@ def save(
 
 def restore(
     path: str, expect_d=None, expect_bound=None, expect_ranks: Optional[int] = None
-) -> Tuple[Frontier, jnp.ndarray, jnp.ndarray, "_Reservoir"]:
+) -> Tuple[Frontier, jnp.ndarray, jnp.ndarray, "_Reservoir", float]:
     """Load a checkpoint; refuses one written for a different instance or
     (the frontier's carried sums are bound-specific) a different bound.
 
     ``expect_ranks``: None for a single-device checkpoint, else the mesh
     size a sharded checkpoint must have been written with.
 
-    Returns ``(frontier, inc_cost, inc_tour, reservoir)`` — the reservoir
-    is empty unless the checkpoint carried spilled nodes."""
+    Returns ``(frontier, inc_cost, inc_tour, reservoir, lb_certified)`` —
+    the reservoir is empty unless the checkpoint carried spilled nodes;
+    ``lb_certified`` is the saved certified-LB floor (-inf for
+    checkpoints predating the key), which resuming solvers clamp their
+    reported lower bound to."""
     z = np.load(_norm_ckpt_path(path))
     saved_ranks = int(z["num_ranks"]) if "num_ranks" in z else None
     if saved_ranks != expect_ranks:
@@ -2749,4 +2956,8 @@ def restore(
         reservoir.chunks.append(
             _pack_rows_np(*(z[f"res_{f}"] for f in CKPT_NODE_FIELDS))
         )
-    return fr, jnp.asarray(z["inc_cost"]), jnp.asarray(z["inc_tour"]), reservoir
+    lb = float(z["lb_certified"]) if "lb_certified" in z else -np.inf
+    return (
+        fr, jnp.asarray(z["inc_cost"]), jnp.asarray(z["inc_tour"]), reservoir,
+        lb,
+    )

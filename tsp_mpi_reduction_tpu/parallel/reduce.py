@@ -226,6 +226,46 @@ def pmin_incumbent(value: jnp.ndarray, axis_name: str = RANK_AXIS) -> jnp.ndarra
     return jax.lax.pmin(value, axis_name)
 
 
+def make_rank_alive_min(mesh: jax.sharding.Mesh, integral: bool = False):
+    """Build the per-rank ALIVE-minimum bound collective for ``mesh``.
+
+    The sharded B&B engine's spill decision needs, per rank, the minimum
+    lower bound over that rank's OPEN nodes (live rows the incumbent has
+    not yet closed): the reservoir-vs-frontier comparison that selects
+    the device-resident fast path in ``solve_sharded``'s ``spill_refill``
+    (merge the host reservoir only when it actually owns better nodes
+    than the live frontier). Computing the minima on device keeps the
+    per-spill readback at [R] floats — the full bound columns never
+    leave the device.
+
+    Returns a jitted callable ``(bounds [R, F] f32, counts [R] i32,
+    inc scalar f32) -> [R] f32`` where element r is rank r's alive
+    minimum (+inf when the rank holds no open node). Each rank's min is
+    computed shard-locally under ``shard_map`` — no cross-rank traffic.
+    ``integral`` selects the fixed-point alive predicate
+    (``bound <= inc - 1``) matching the engine's ceil-aware pruning.
+    """
+
+    def body(bounds, counts, inc):
+        b = bounds[0]
+        pos = jnp.arange(b.shape[0], dtype=jnp.int32)
+        alive = pos < counts[0]
+        if integral:
+            alive = alive & (b <= inc - 1.0)
+        else:
+            alive = alive & (b < inc)
+        return jnp.min(jnp.where(alive, b, jnp.inf))[None]
+
+    return jax.jit(
+        shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(RANK_AXIS), P(RANK_AXIS), P()),
+            out_specs=P(RANK_AXIS),
+        )
+    )
+
+
 def compat_capacity(num_blocks: int, n: int, num_ranks: int) -> int:
     """Buffer size needed by the ``compat_bugs`` reduce (host simulation).
 
