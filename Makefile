@@ -20,7 +20,9 @@ all: lint native oracle
 
 # --- static analysis: graftlint (JAX-hazard rules R1-R5, see README) plus
 # ruff when available (ruff.toml pins a minimal critical-error set; the
-# container image has no ruff, so fall back to a syntax-only compile check)
+# container image has no ruff, so fall back to a syntax-only compile check).
+# The default target set covers the whole package — including the serve/
+# layer, which the zero-entry baseline ratchet holds to no hot-path debt.
 lint:
 	$(PY) -m tsp_mpi_reduction_tpu.analysis
 	@if command -v ruff >/dev/null 2>&1; then ruff check .; \
@@ -55,6 +57,11 @@ test-fast:
 # --- benchmark: one JSON line on the current accelerator ---
 bench:
 	$(PY) bench.py
+
+# serving-layer acceptance bench: batched vs sequential throughput, cache
+# hit rate, deadline-ladder behavior -> BENCH_SERVE.json
+bench-serve:
+	TSP_BENCH=serve $(PY) bench.py
 
 # reference `make run` analog: same config, 3-rank-shaped merge tree
 run:

@@ -1,5 +1,8 @@
 """Profiling utilities: phase timers and jax.profiler trace capture."""
 
+import threading
+import time
+
 import jax.numpy as jnp
 
 from tsp_mpi_reduction_tpu.utils.profiling import PhaseTimer, device_trace
@@ -24,6 +27,32 @@ def test_phase_timer_records_on_exception():
     except RuntimeError:
         pass
     assert "boom" in t.seconds
+
+
+def test_phase_timer_thread_safe_merge():
+    """The serve scheduler's worker thread and request threads share one
+    timer: concurrent merges into the same phase must not lose updates
+    (the unlocked read-modify-write raced before ISSUE 3)."""
+    t = PhaseTimer()
+    rounds, threads = 200, 8
+    sleep_s = 1e-5
+
+    def hammer():
+        for _ in range(rounds):
+            with t.phase("shared"):
+                time.sleep(sleep_s)
+            with t.phase("shared2"):
+                pass
+
+    workers = [threading.Thread(target=hammer) for _ in range(threads)]
+    for w in workers:
+        w.start()
+    for w in workers:
+        w.join()
+    # every merge must land: the accumulated total is at least the sum of
+    # all sleeps (a lost update would undercount)
+    assert t.seconds["shared"] >= rounds * threads * sleep_s
+    assert set(t.seconds) == {"shared", "shared2"}
 
 
 def test_device_trace_none_is_noop():

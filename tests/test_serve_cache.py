@@ -1,0 +1,84 @@
+"""LRU solution cache (serve.cache): eviction order, counters, policy."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from tsp_mpi_reduction_tpu.serve.cache import CacheEntry, SolutionCache
+
+pytestmark = pytest.mark.serve
+
+
+def _entry(cost, gap=0.0, tier="pipeline"):
+    return CacheEntry(
+        cost=cost, tour=np.asarray([0, 1, 2, 0], np.int32),
+        certified_gap=gap, tier=tier,
+    )
+
+
+def test_hit_miss_counters():
+    c = SolutionCache(capacity=4)
+    assert c.get("a") is None
+    c.put("a", _entry(1.0))
+    assert c.get("a") is not None
+    s = c.stats()
+    assert (s["hits"], s["misses"], s["evictions"], s["size"]) == (1, 1, 0, 1)
+
+
+def test_lru_eviction_order():
+    c = SolutionCache(capacity=2)
+    c.put("a", _entry(1.0))
+    c.put("b", _entry(2.0))
+    assert c.get("a") is not None  # refresh a: b is now coldest
+    c.put("c", _entry(3.0))
+    assert c.get("b") is None, "coldest entry should have been evicted"
+    assert c.get("a") is not None and c.get("c") is not None
+    assert c.stats()["evictions"] == 1
+
+
+def test_put_keeps_better_entry():
+    c = SolutionCache(capacity=4)
+    c.put("k", _entry(10.0, gap=0.0, tier="bnb"))
+    # a later, WORSE answer (deadline-degraded greedy) must not clobber it
+    c.put("k", _entry(12.0, gap=None, tier="greedy"))
+    assert c.get("k").tier == "bnb"
+    # a strictly cheaper tour replaces
+    c.put("k", _entry(9.0, gap=None, tier="pipeline"))
+    assert c.get("k").cost == 9.0
+    # equal cost: a certificate beats none
+    c.put("k", _entry(9.0, gap=0.0, tier="bnb"))
+    assert c.get("k").certified_gap == 0.0
+
+
+def test_capacity_validation():
+    with pytest.raises(ValueError):
+        SolutionCache(capacity=0)
+
+
+def test_concurrent_access_consistent():
+    c = SolutionCache(capacity=64)
+    errs = []
+
+    def worker(seed):
+        try:
+            rng = np.random.default_rng(seed)
+            for _ in range(300):
+                k = f"k{int(rng.integers(0, 100))}"
+                if rng.random() < 0.5:
+                    c.put(k, _entry(float(rng.random())))
+                else:
+                    c.get(k)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    s = c.stats()
+    assert s["size"] <= 64
+    # every get either hit or missed — 8 threads x 300 ops, ~half gets
+    assert s["hits"] + s["misses"] + s["evictions"] > 0

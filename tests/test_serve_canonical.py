@@ -1,0 +1,140 @@
+"""Property tests for canonical instance keys (serve.canonical).
+
+The cache-key contract: translation of the whole instance, permutation of
+the city list, and float jitter below half the quantization step must all
+map to the SAME key; genuinely different instances must not collide. The
+sort permutation must relabel tours correctly in both directions.
+"""
+
+import numpy as np
+import pytest
+
+from tsp_mpi_reduction_tpu.serve.canonical import (
+    canonicalize,
+    from_canonical_tour,
+    to_canonical_tour,
+    tour_length_np,
+)
+
+pytestmark = pytest.mark.serve
+
+STEP = 1e-3
+
+
+def _grid_instance(rng, n, step=STEP):
+    """Random instance with coordinates ON the quantization grid (multiples
+    of 10*step), so jitter/translation margins are exact."""
+    return rng.integers(0, 100_000, (n, 2)).astype(np.float64) * (10 * step)
+
+
+@pytest.mark.parametrize("n", [3, 8, 17, 64])
+def test_translation_invariance(n):
+    rng = np.random.default_rng(n)
+    xy = _grid_instance(rng, n)
+    base = canonicalize(xy, STEP)
+    for trial in range(20):
+        # arbitrary real-valued translations: with on-grid coordinates the
+        # common shift rounds identically for every city
+        t = rng.uniform(-5_000.0, 5_000.0, (1, 2))
+        assert canonicalize(xy + t, STEP).key == base.key, f"trial {trial}"
+
+
+@pytest.mark.parametrize("n", [3, 8, 17, 64])
+def test_permutation_invariance(n):
+    rng = np.random.default_rng(100 + n)
+    xy = _grid_instance(rng, n)
+    base = canonicalize(xy, STEP)
+    for trial in range(20):
+        perm = rng.permutation(n)
+        assert canonicalize(xy[perm], STEP).key == base.key, f"trial {trial}"
+
+
+@pytest.mark.parametrize("n", [3, 8, 17, 64])
+def test_jitter_below_half_step_invariance(n):
+    rng = np.random.default_rng(200 + n)
+    xy = _grid_instance(rng, n)
+    base = canonicalize(xy, STEP)
+    for trial in range(20):
+        jitter = rng.uniform(-0.49 * STEP, 0.49 * STEP, xy.shape)
+        assert canonicalize(xy + jitter, STEP).key == base.key, f"trial {trial}"
+
+
+def test_combined_translation_permutation_jitter():
+    rng = np.random.default_rng(7)
+    xy = _grid_instance(rng, 23)
+    base = canonicalize(xy, STEP)
+    for trial in range(50):
+        # translation by grid multiples composes exactly with sub-half-step
+        # jitter; permutation is free
+        t = rng.integers(-10_000, 10_000, (1, 2)) * STEP
+        jitter = rng.uniform(-0.25 * STEP, 0.25 * STEP, xy.shape)
+        perm = rng.permutation(23)
+        assert canonicalize((xy + t + jitter)[perm], STEP).key == base.key
+
+
+def test_distinct_instances_do_not_collide():
+    rng = np.random.default_rng(11)
+    keys = set()
+    for _ in range(300):
+        n = int(rng.integers(3, 30))
+        keys.add(canonicalize(rng.uniform(0, 1000, (n, 2)), STEP).key)
+    assert len(keys) == 300, "canonical keys collided across random instances"
+
+
+def test_moving_one_city_changes_key():
+    rng = np.random.default_rng(13)
+    xy = _grid_instance(rng, 12)
+    base = canonicalize(xy, STEP)
+    moved = xy.copy()
+    moved[5] += 10 * STEP  # one city, one grid cell over
+    assert canonicalize(moved, STEP).key != base.key
+
+
+def test_scaling_changes_key():
+    # scaling is NOT an invariance (distances change) — keys must differ
+    rng = np.random.default_rng(17)
+    xy = _grid_instance(rng, 9)
+    assert canonicalize(xy * 2.0, STEP).key != canonicalize(xy, STEP).key
+
+
+def test_tour_relabel_roundtrip():
+    rng = np.random.default_rng(19)
+    xy = rng.uniform(0, 1000, (10, 2))
+    ci = canonicalize(xy, STEP)
+    tour = np.asarray(list(rng.permutation(10)) + [0], np.int32)
+    tour[-1] = tour[0]  # closed
+    canon_t = to_canonical_tour(tour, ci)
+    back = from_canonical_tour(canon_t, ci)
+    np.testing.assert_array_equal(back, tour)
+
+
+def test_cached_tour_transfers_across_permutation():
+    """The serving property the maps exist for: a tour cached in canonical
+    ids, relabeled into a permuted resubmission, visits the same points in
+    the same order (same true length)."""
+    rng = np.random.default_rng(23)
+    n = 12
+    xy = rng.uniform(0, 1000, (n, 2))
+    ci = canonicalize(xy, STEP)
+    tour = np.asarray(list(rng.permutation(n)) + [0], np.int64)
+    tour[-1] = tour[0]
+    canon_t = to_canonical_tour(tour, ci)
+
+    perm = rng.permutation(n)
+    xy2 = xy[perm] + 50.0
+    ci2 = canonicalize(xy2, STEP)
+    tour2 = from_canonical_tour(canon_t, ci2)
+    assert np.isclose(
+        tour_length_np(tour, xy), tour_length_np(tour2, xy2), rtol=0, atol=1e-9
+    )
+
+
+def test_validation_errors():
+    with pytest.raises(ValueError):
+        canonicalize(np.zeros((0, 2)))
+    with pytest.raises(ValueError):
+        canonicalize(np.zeros((4, 3)))
+    with pytest.raises(ValueError):
+        canonicalize(np.asarray([[np.nan, 0.0]]))
+    with pytest.raises(ValueError):
+        canonicalize(np.zeros((4, 2)), step=0.0)

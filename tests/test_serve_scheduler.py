@@ -1,0 +1,117 @@
+"""Micro-batch scheduler (serve.scheduler): grouping, padding, correctness.
+
+Small n keeps Held-Karp compiles cheap; the scheduler's bucket set is
+restricted per-test so the suite compiles a handful of shapes, not eight.
+"""
+
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tsp_mpi_reduction_tpu.ops.distance import distance_matrix_np
+from tsp_mpi_reduction_tpu.ops.held_karp import solve_blocks_from_dists
+from tsp_mpi_reduction_tpu.serve.scheduler import MicroBatchScheduler
+
+pytestmark = pytest.mark.serve
+
+N = 6  # block size for every scheduler test: one compile per bucket shape
+
+
+def _instances(rng, count, n=N):
+    return np.stack([distance_matrix_np(rng.uniform(0, 100, (n, 2))) for _ in range(count)])
+
+
+def test_batched_results_match_direct_solve():
+    rng = np.random.default_rng(0)
+    ds = _instances(rng, 8)
+    ref_costs, ref_tours = solve_blocks_from_dists(
+        jnp.asarray(ds, jnp.float32), jnp.float32
+    )
+    with MicroBatchScheduler(max_batch=8, max_wait_ms=20.0, buckets=(8,)) as s:
+        tickets = [s.submit(ds[i : i + 1]) for i in range(8)]
+        results = [t.wait(timeout=60.0) for t in tickets]
+    assert all(r is not None for r in results)
+    for i, (costs, tours) in enumerate(results):
+        assert costs.shape == (1,) and tours.shape == (1, N + 1)
+        np.testing.assert_array_equal(tours[0], np.asarray(ref_tours)[i])
+        np.testing.assert_allclose(costs[0], np.asarray(ref_costs)[i], rtol=1e-6)
+
+
+def test_concurrent_submissions_form_batches():
+    rng = np.random.default_rng(1)
+    ds = _instances(rng, 16)
+    with MicroBatchScheduler(max_batch=16, max_wait_ms=50.0, buckets=(16,)) as s:
+        barrier = threading.Barrier(16)
+        results = [None] * 16
+
+        def submit(i):
+            barrier.wait()
+            results[i] = s.submit(ds[i : i + 1]).wait(timeout=60.0)
+
+        threads = [threading.Thread(target=submit, args=(i,)) for i in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        stats = s.stats()
+    assert all(r is not None for r in results)
+    assert stats["blocks_solved"] == 16
+    # 16 concurrent submissions must NOT take 16 device calls
+    assert stats["batches"] < 16, f"no batching happened: {stats}"
+    assert stats["queue_depth_hwm"] > 1
+
+
+def test_multi_block_submission_and_padding_occupancy():
+    rng = np.random.default_rng(2)
+    ds = _instances(rng, 5)
+    with MicroBatchScheduler(max_batch=8, max_wait_ms=1.0, buckets=(8,)) as s:
+        costs, tours = s.submit(ds).wait(timeout=60.0)
+        stats = s.stats()
+    assert costs.shape == (5,) and tours.shape == (5, N + 1)
+    assert stats["blocks_solved"] == 5
+    assert stats["padded_blocks"] == 8  # padded up to the bucket
+    assert 0 < stats["batch_occupancy"] < 1
+
+
+def test_mixed_shapes_grouped_separately():
+    rng = np.random.default_rng(3)
+    d6 = _instances(rng, 2, n=6)
+    d7 = _instances(rng, 2, n=7)
+    with MicroBatchScheduler(max_batch=4, max_wait_ms=5.0, buckets=(2, 4)) as s:
+        t6 = [s.submit(d6[i : i + 1]) for i in range(2)]
+        t7 = [s.submit(d7[i : i + 1]) for i in range(2)]
+        r6 = [t.wait(timeout=60.0) for t in t6]
+        r7 = [t.wait(timeout=60.0) for t in t7]
+    assert all(r is not None for r in r6 + r7)
+    assert r6[0][1].shape == (1, 7) and r7[0][1].shape == (1, 8)
+
+
+def test_submit_validation_is_synchronous():
+    with MicroBatchScheduler() as s:
+        with pytest.raises(ValueError):
+            s.submit(np.zeros((1, 2, 2)))  # n < 3
+        with pytest.raises(ValueError):
+            s.submit(np.zeros((1, 19, 19)))  # n > MAX_BLOCK_CITIES
+        with pytest.raises(ValueError):
+            s.submit(np.zeros((4, 4)))  # not [B, n, n]
+        with pytest.raises(ValueError):
+            s.submit(np.zeros((0, 6, 6)))  # empty
+
+
+def test_close_fails_pending_and_rejects_new():
+    s = MicroBatchScheduler(max_wait_ms=10_000.0)  # worker will sit waiting
+    s.close()
+    with pytest.raises(RuntimeError):
+        s.submit(np.zeros((1, 6, 6)))
+    s.close()  # idempotent
+
+
+def test_oversized_submission_flushes_alone():
+    rng = np.random.default_rng(4)
+    ds = _instances(rng, 3)
+    # max_batch=2 < submission's 3 blocks: must still flush, not starve
+    with MicroBatchScheduler(max_batch=2, max_wait_ms=1.0, buckets=(2, 4)) as s:
+        got = s.submit(ds).wait(timeout=60.0)
+    assert got is not None and got[0].shape == (3,)

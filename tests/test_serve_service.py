@@ -1,0 +1,363 @@
+"""Deadline ladder + end-to-end service tests (serve.ladder / serve.service)."""
+
+import io
+import json
+
+import numpy as np
+import pytest
+
+from tsp_mpi_reduction_tpu.serve.ladder import (
+    DeadlineLadder,
+    LadderConfig,
+    LatencyEstimator,
+    _largest_block_divisor,
+)
+from tsp_mpi_reduction_tpu.serve.scheduler import MicroBatchScheduler
+from tsp_mpi_reduction_tpu.serve.service import (
+    ServiceConfig,
+    SolveService,
+    run_jsonl,
+)
+
+pytestmark = pytest.mark.serve
+
+
+def _valid_closed_tour(tour, n):
+    tour = list(tour)
+    return tour[0] == tour[-1] and sorted(tour[:-1]) == list(range(n))
+
+
+# -- ladder ------------------------------------------------------------------
+
+
+def _ladder(**cfg_kw):
+    sched = MicroBatchScheduler(max_batch=8, max_wait_ms=1.0, buckets=(1, 2, 4, 8))
+    return DeadlineLadder(sched, LadderConfig(**cfg_kw)), sched
+
+
+def test_impossible_deadline_answers_greedy():
+    ladder, sched = _ladder()
+    with sched:
+        rng = np.random.default_rng(0)
+        xy = rng.uniform(0, 1000, (9, 2))
+        res = ladder.solve(xy, deadline_s=0.0)
+    assert res.tier == "greedy"
+    assert _valid_closed_tour(res.tour, 9)
+    assert res.certified_gap is None
+    assert ladder.tier_counts["greedy"] == 1
+
+
+def test_generous_deadline_uses_exact_pipeline():
+    ladder, sched = _ladder(bnb_max_n=0)  # bnb rung disabled -> pipeline
+    with sched:
+        rng = np.random.default_rng(1)
+        xy = rng.uniform(0, 1000, (8, 2))
+        res = ladder.solve(xy, deadline_s=120.0)
+    assert res.tier == "pipeline"
+    assert res.certified_gap == 0.0  # single-block Held-Karp is exact
+    assert _valid_closed_tour(res.tour, 8)
+
+
+def test_bnb_rung_selected_and_certified():
+    calls = {}
+
+    def fake_bnb(d, time_limit_s):
+        calls["limit"] = time_limit_s
+        n = d.shape[0]
+        tour = np.asarray(list(range(n)) + [0], np.int32)
+        cost = float(d[tour[:-1], tour[1:]].sum())
+        return cost, tour, cost, True  # proven
+
+    ladder, sched = _ladder(bnb_solver=fake_bnb, bnb_min_budget_s=0.1)
+    ladder.estimator.observe("bnb", 8, 0.01)  # teach: bnb is cheap here
+    with sched:
+        rng = np.random.default_rng(2)
+        res = ladder.solve(rng.uniform(0, 1000, (8, 2)), deadline_s=30.0)
+    assert res.tier == "bnb"
+    assert res.certified_gap == 0.0
+    assert 0 < calls["limit"] < 30.0  # budget fraction applied
+
+
+def test_bnb_unproven_reports_certified_gap():
+    def fake_bnb(d, time_limit_s):
+        n = d.shape[0]
+        tour = np.asarray(list(range(n)) + [0], np.int32)
+        return 110.0, tour, 100.0, False  # cost 110, certified LB 100
+
+    ladder, sched = _ladder(bnb_solver=fake_bnb, bnb_min_budget_s=0.1)
+    ladder.estimator.observe("bnb", 8, 0.01)
+    with sched:
+        rng = np.random.default_rng(3)
+        res = ladder.solve(rng.uniform(0, 1000, (8, 2)), deadline_s=30.0)
+    assert res.tier == "bnb"
+    assert res.certified_gap == pytest.approx(0.1)
+    assert res.lower_bound == 100.0
+
+
+def test_real_bnb_rung_proves_tiny_instance():
+    ladder, sched = _ladder(
+        bnb_min_budget_s=0.1, bnb_capacity=1 << 10, bnb_k=8
+    )
+    ladder.estimator.observe("bnb", 8, 0.01)
+    with sched:
+        rng = np.random.default_rng(4)
+        xy = rng.uniform(0, 100, (7, 2))
+        res = ladder.solve(xy, deadline_s=300.0)
+    assert res.tier == "bnb"
+    assert res.certified_gap == 0.0
+    assert _valid_closed_tour(res.tour, 7)
+
+
+def test_blocked_pipeline_large_instance():
+    # n=24 > MAX_BLOCK_CITIES: blocked decomposition (b=12), merge, polish
+    ladder, sched = _ladder(bnb_max_n=0, polish_rounds=2)
+    with sched:
+        rng = np.random.default_rng(5)
+        xy = rng.uniform(0, 1000, (24, 2))
+        res = ladder.solve(xy, deadline_s=300.0)
+    assert res.tier == "pipeline"
+    assert res.certified_gap is None  # heuristic rung: no certificate
+    assert _valid_closed_tour(res.tour, 24)
+
+
+def test_trivial_instances():
+    ladder, sched = _ladder()
+    with sched:
+        r1 = ladder.solve(np.asarray([[1.0, 2.0]]), deadline_s=10.0)
+        r2 = ladder.solve(np.asarray([[0.0, 0.0], [3.0, 4.0]]), deadline_s=10.0)
+    assert list(r1.tour) == [0, 0] and r1.cost == 0.0
+    assert list(r2.tour) == [0, 1, 0] and r2.cost == pytest.approx(10.0)
+
+
+def test_largest_block_divisor():
+    assert _largest_block_divisor(24) == 12
+    assert _largest_block_divisor(32) == 16
+    assert _largest_block_divisor(33) == 11
+    assert _largest_block_divisor(23) is None  # prime > 16
+    assert _largest_block_divisor(18) == 9
+
+
+def test_latency_estimator_ewma():
+    est = LatencyEstimator(alpha=0.5)
+    assert est.estimate("bnb", 8, 5.0) == 5.0  # prior until observed
+    est.observe("bnb", 8, 1.0)
+    assert est.estimate("bnb", 8, 5.0) == 1.0
+    est.observe("bnb", 8, 3.0)
+    assert est.estimate("bnb", 8, 5.0) == pytest.approx(2.0)
+    # bucketing: n=7 and n=8 share a bucket, n=9 does not
+    assert est.estimate("bnb", 7, 9.0) == pytest.approx(2.0)
+    assert est.estimate("bnb", 9, 9.0) == 9.0
+
+
+# -- service -----------------------------------------------------------------
+
+
+def _cfg(**kw):
+    kw.setdefault("ladder", LadderConfig(bnb_max_n=0))
+    kw.setdefault("max_wait_ms", 5.0)
+    return ServiceConfig(**kw)
+
+
+def test_service_miss_then_permuted_translated_hit():
+    rng = np.random.default_rng(10)
+    xy = rng.uniform(0, 1000, (8, 2))
+    with SolveService(_cfg()) as svc:
+        r1 = svc.handle({"id": "a", "xy": xy.tolist(), "deadline_ms": 60_000})
+        dup = xy[rng.permutation(8)] + 123.0
+        r2 = svc.handle({"id": "b", "xy": dup.tolist(), "deadline_ms": 60_000})
+    assert r1["cache"] == "miss" and r2["cache"] == "hit"
+    assert r2["tier"] == r1["tier"]
+    assert _valid_closed_tour(r2["tour"], 8)
+    # same geometry -> same measured cost (translation-invariant)
+    assert r2["cost"] == pytest.approx(r1["cost"], rel=1e-9)
+
+
+def test_service_tight_deadline_never_errors():
+    with SolveService(_cfg()) as svc:
+        rng = np.random.default_rng(11)
+        for i in range(5):
+            xy = rng.uniform(0, 1000, (10, 2))
+            resp = svc.handle(
+                {"id": i, "xy": xy.tolist(), "deadline_ms": 0.001}
+            )
+            assert "error" not in resp
+            assert resp["tier"] == "greedy"
+            assert _valid_closed_tour(resp["tour"], 10)
+            assert resp["deadline_missed"] is True
+        assert svc.deadline_misses == 5
+
+
+def test_service_malformed_requests_get_error_responses():
+    with SolveService(_cfg()) as svc:
+        assert "error" in svc.handle({"id": 1})  # no xy
+        assert "error" in svc.handle({"id": 2, "xy": [[1, 2, 3]]})  # bad shape
+        assert "error" in svc.handle({"id": 3, "xy": "nope"})
+        assert svc.errors == 3
+
+
+def test_run_jsonl_order_and_stats():
+    rng = np.random.default_rng(12)
+    lines = []
+    for i in range(6):
+        xy = rng.uniform(0, 1000, (7, 2))
+        lines.append(json.dumps(
+            {"id": f"r{i}", "xy": xy.tolist(), "deadline_ms": 60_000}
+        ))
+    lines.insert(3, "not json{")
+    lines.insert(5, json.dumps([1, 2, 3]))  # JSON but not an object
+    out = io.StringIO()
+    svc = run_jsonl(lines, out, _cfg(threads=4))
+    rows = [json.loads(x) for x in out.getvalue().strip().splitlines()]
+    assert len(rows) == 8
+    # responses come back in INPUT order
+    ids = [r.get("id") for r in rows]
+    assert ids == ["r0", "r1", "r2", None, "r3", None, "r4", "r5"]
+    assert "error" in rows[3] and "error" in rows[5]
+    stats = json.loads(svc.stats_json())
+    assert stats["responses"] == 6 and stats["errors"] == 2
+    assert stats["tiers"]["pipeline"] == 6
+    assert stats["cache"]["misses"] >= 6
+    assert stats["scheduler"]["blocks_solved"] == 6
+    assert "queue_depth_hwm" in stats["scheduler"]
+    assert "batch_occupancy" in stats["scheduler"]
+
+
+def test_service_cache_prefers_certified_entry():
+    """A deadline-degraded greedy answer must not clobber a cached exact
+    one, and a later hit returns the exact tier."""
+    rng = np.random.default_rng(13)
+    xy = rng.uniform(0, 1000, (8, 2))
+    with SolveService(_cfg()) as svc:
+        r1 = svc.handle({"id": "a", "xy": xy.tolist(), "deadline_ms": 60_000})
+        assert r1["tier"] == "pipeline" and r1["certified_gap"] == 0.0
+        # resubmit with an impossible deadline: the HIT serves the cached
+        # exact answer without running any rung at all
+        r2 = svc.handle({"id": "b", "xy": xy.tolist(), "deadline_ms": 0.001})
+        assert r2["cache"] == "hit" and r2["tier"] == "pipeline"
+
+
+def test_service_upgrades_cached_greedy_on_generous_budget():
+    """Finding-3 regression: a greedy answer cached under an impossible
+    deadline must NOT pin the instance — a later generous-budget request
+    re-solves with a stronger rung ('refresh') and upgrades the cache."""
+    rng = np.random.default_rng(20)
+    xy = rng.uniform(0, 1000, (8, 2))
+    with SolveService(_cfg()) as svc:
+        r1 = svc.handle({"id": "a", "xy": xy.tolist(), "deadline_ms": 0.001})
+        assert r1["tier"] == "greedy" and r1["cache"] == "miss"
+        r2 = svc.handle({"id": "b", "xy": xy.tolist(), "deadline_ms": 60_000})
+        assert r2["cache"] == "refresh"
+        assert r2["tier"] == "pipeline" and r2["certified_gap"] == 0.0
+        assert r2["cost"] <= r1["cost"] + 1e-9  # upgrade never serves worse
+        # now exact is cached: a third request is a plain hit, no re-solve
+        r3 = svc.handle({"id": "c", "xy": xy.tolist(), "deadline_ms": 60_000})
+        assert r3["cache"] == "hit" and r3["tier"] == "pipeline"
+        assert svc.refreshes == 1
+
+
+def test_ladder_rung_exception_degrades_to_greedy():
+    """Finding-1 regression: a rung that raises (device OOM, solver bug)
+    must degrade like a timeout — the request still gets a valid tour and
+    the stream never sees an exception."""
+
+    def exploding_bnb(d, time_limit_s):
+        raise MemoryError("synthetic device OOM")
+
+    ladder, sched = _ladder(bnb_solver=exploding_bnb, bnb_min_budget_s=0.1)
+    ladder.estimator.observe("bnb", 8, 0.01)
+    with sched:
+        rng = np.random.default_rng(21)
+        res = ladder.solve(rng.uniform(0, 1000, (8, 2)), deadline_s=30.0)
+    assert res.tier in ("pipeline", "greedy")  # degraded, not raised
+    assert _valid_closed_tour(res.tour, 8)
+    assert ladder.rung_failures["bnb"] == 1
+
+
+def test_ladder_timeout_teaches_estimator():
+    """Finding-2 regression: a pipeline rung that times out must still
+    update the latency EWMA, so the ladder stops promising it."""
+
+    class NeverTicket:
+        def wait(self, timeout=None):
+            import time as _t
+
+            _t.sleep(min(timeout or 0.01, 0.05))
+            return None  # simulated: batch never completes in budget
+
+    class StuckScheduler:
+        def submit(self, dists):
+            return NeverTicket()
+
+        def close(self):
+            pass
+
+    ladder = DeadlineLadder(StuckScheduler(), LadderConfig(bnb_max_n=0))
+    rng = np.random.default_rng(22)
+    xy = rng.uniform(0, 1000, (8, 2))
+    res = ladder.solve(xy, deadline_s=0.6)  # > pipeline prior of 0.5
+    assert res.tier == "greedy"
+    # the burned budget was observed: estimate rose above the prior
+    assert ladder.estimator.estimate("pipeline", 8, 0.0) > 0.0
+
+
+def test_run_jsonl_streams_responses_before_input_ends():
+    """Finding-5 regression: responses must be written as they complete,
+    not after the input iterable is exhausted (interactive pipe clients)."""
+    import threading as _threading
+
+    rng = np.random.default_rng(23)
+    seen = _threading.Event()
+    gate = _threading.Event()
+
+    class StreamingOut:
+        def __init__(self):
+            self.lines = []
+
+        def write(self, s):
+            self.lines.append(s)
+            seen.set()
+
+        def flush(self):
+            pass
+
+    def lazy_lines():
+        yield json.dumps(
+            {"id": "first", "xy": rng.uniform(0, 1000, (7, 2)).tolist(),
+             "deadline_ms": 60_000}
+        )
+        # block the INPUT until the first response has been written
+        assert seen.wait(timeout=60.0), "no response before input ended"
+        gate.set()
+        yield json.dumps(
+            {"id": "second", "xy": rng.uniform(0, 1000, (7, 2)).tolist(),
+             "deadline_ms": 60_000}
+        )
+
+    out = StreamingOut()
+    run_jsonl(lazy_lines(), out, _cfg(threads=2))
+    assert gate.is_set()
+    rows = [json.loads(x) for x in out.lines]
+    assert [r["id"] for r in rows] == ["first", "second"]
+
+
+def test_serve_cli_reads_and_writes_files(tmp_path):
+    from tsp_mpi_reduction_tpu.utils.cli import main
+
+    rng = np.random.default_rng(14)
+    inp = tmp_path / "req.jsonl"
+    outp = tmp_path / "resp.jsonl"
+    reqs = [
+        {"id": i, "xy": rng.uniform(0, 1000, (7, 2)).tolist(),
+         "deadline_ms": 60_000}
+        for i in range(3)
+    ]
+    inp.write_text("".join(json.dumps(r) + "\n" for r in reqs))
+    rc = main([
+        "serve", "--in", str(inp), "--out", str(outp),
+        "--backend", "cpu", "--max-wait-ms", "5",
+    ])
+    assert rc == 0
+    rows = [json.loads(x) for x in outp.read_text().strip().splitlines()]
+    assert [r["id"] for r in rows] == [0, 1, 2]
+    for r in rows:
+        assert _valid_closed_tour(r["tour"], 7)

@@ -1,0 +1,34 @@
+"""serve/: the batched solve service layer (ISSUE 3 tentpole).
+
+Turns the strictly batch-oriented solver stack into a request-serving
+system, following Clipper's prediction-cache + adaptive-batching design
+and ORCA's continuous batching (PAPERS.md):
+
+- ``canonical``  translation/permutation/jitter-invariant instance keys
+- ``cache``      bounded LRU of canonical solutions with certificates
+- ``scheduler``  micro-batching: N pending solves -> one padded vmap call
+- ``ladder``     deadline-aware degradation: bnb -> pipeline -> greedy
+- ``service``    JSONL request/response loop + ``serve`` CLI mode
+"""
+
+from .cache import CacheEntry, SolutionCache
+from .canonical import CanonicalInstance, canonicalize
+from .ladder import TIERS, DeadlineLadder, LadderConfig, LadderResult
+from .scheduler import MicroBatchScheduler
+from .service import ServiceConfig, SolveService, run_jsonl, serve_cli
+
+__all__ = [
+    "CacheEntry",
+    "SolutionCache",
+    "CanonicalInstance",
+    "canonicalize",
+    "TIERS",
+    "DeadlineLadder",
+    "LadderConfig",
+    "LadderResult",
+    "MicroBatchScheduler",
+    "ServiceConfig",
+    "SolveService",
+    "run_jsonl",
+    "serve_cli",
+]

@@ -1,0 +1,110 @@
+"""Canonical instance keys: dedupe resubmitted TSP instances.
+
+A serving cache (Clipper's prediction cache, PAPERS.md) is only as good as
+its key function. Raw coordinate bytes miss the two resubmission patterns
+that actually occur: the same instance *translated* in the plane (Euclidean
+TSP is translation-invariant) and the same instance with its cities listed
+in a different *order* (the tour relabels trivially). This module maps an
+instance to a canonical form that is invariant under both, plus float
+jitter below half the quantization step:
+
+1. quantize: ``q = rint(xy / step)`` snaps coordinates to an integer grid,
+   absorbing sub-step noise (invariance holds for jitter strictly below
+   ``step/2`` around a grid point — at exactly ``step/2`` rounding ties);
+2. translate: ``q -= q.min(axis=0)`` pins the bounding-box corner to the
+   origin (uniform for all cities, so any common shift cancels);
+3. reorder: cities sort lexicographically by ``(qx, qy)`` — the unique
+   minimal relabeling, so every permutation of the same city list lands on
+   the same array (``np.lexsort`` is stable: quantization-tied cities keep
+   their relative submission order; such cities are geometrically
+   indistinguishable at the key's resolution, so either assignment maps a
+   cached tour onto an equally-valid tour of the resubmitted instance);
+4. hash: blake2b over ``n`` and the canonical int64 array.
+
+The returned :class:`CanonicalInstance` keeps the sort permutation so a
+tour cached in canonical city ids can be relabeled into any later
+submission's city order (:func:`from_canonical_tour`) and vice versa.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+
+import numpy as np
+
+#: default quantization step in coordinate units. The repo's workloads put
+#: cities on [0, 1000]^2 grids (tsp.cpp:373-403 scale), so 1e-3 keeps ~6
+#: significant digits — far below any distance that changes a tour — while
+#: absorbing float32<->float64 round-trip noise (~1e-5 at that scale).
+DEFAULT_STEP = 1e-3
+
+
+@dataclass(frozen=True)
+class CanonicalInstance:
+    """Canonical form of one instance plus the maps back to request space."""
+
+    key: str  #: hex digest — the cache key
+    n: int
+    #: [n] canonical position -> original city index (``xy[perm]`` is sorted)
+    perm: np.ndarray
+    #: [n] original city index -> canonical position (``perm``'s inverse)
+    inv_perm: np.ndarray
+    #: [n, 2] int64 quantized, origin-pinned, sorted coordinates
+    qxy: np.ndarray
+
+
+def canonicalize(xy, step: float = DEFAULT_STEP) -> CanonicalInstance:
+    """Build the canonical key for an ``[n, 2]`` coordinate array.
+
+    Raises ``ValueError`` on malformed input (wrong shape, empty, or
+    non-finite coordinates) — the service turns that into an error
+    response rather than a cache poisoning.
+    """
+    xy = np.asarray(xy, np.float64)
+    if xy.ndim != 2 or xy.shape[-1] != 2 or xy.shape[0] < 1:
+        raise ValueError(f"expected [n>=1, 2] coordinates, got shape {xy.shape}")
+    if not np.all(np.isfinite(xy)):
+        raise ValueError("coordinates must be finite")
+    if not step > 0:
+        raise ValueError(f"quantization step must be > 0, got {step}")
+    q = np.rint(xy / step).astype(np.int64)
+    q -= q.min(axis=0)  # translation invariance: pin bbox corner to origin
+    # lexicographic-minimal city order: primary qx, secondary qy (np.lexsort
+    # keys are listed least-significant first)
+    perm = np.lexsort((q[:, 1], q[:, 0])).astype(np.int64)
+    qs = np.ascontiguousarray(q[perm])
+    h = hashlib.blake2b(digest_size=16)
+    h.update(np.int64(qs.shape[0]).tobytes())
+    h.update(qs.tobytes())
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(perm.shape[0], dtype=np.int64)
+    return CanonicalInstance(
+        key=h.hexdigest(), n=int(xy.shape[0]), perm=perm, inv_perm=inv, qxy=qs
+    )
+
+
+def to_canonical_tour(tour: np.ndarray, ci: CanonicalInstance) -> np.ndarray:
+    """Relabel a tour of request-space city ids into canonical ids."""
+    return ci.inv_perm[np.asarray(tour, np.int64)].astype(np.int32)
+
+
+def from_canonical_tour(tour: np.ndarray, ci: CanonicalInstance) -> np.ndarray:
+    """Relabel a cached canonical-id tour into this request's city ids."""
+    return ci.perm[np.asarray(tour, np.int64)].astype(np.int32)
+
+
+def tour_length_np(tour: np.ndarray, xy: np.ndarray) -> float:
+    """True Euclidean length of a CLOSED tour under the request's own
+    (unquantized) coordinates — re-measured on every cache hit so the
+    reported cost is honest for *this* submission, not the one that
+    populated the cache (they can differ by sub-step jitter).
+
+    Edge lengths use the repo-wide ``sqrt(sum(diff*diff))`` form
+    (``ops.distance.distance_matrix_np``), NOT ``np.hypot`` — hypot rounds
+    differently at the ULP level, and service costs must be comparable
+    bit-for-bit with every other entry point's."""
+    t = np.asarray(tour, np.int64)
+    p = np.asarray(xy, np.float64)[t]
+    diff = p[1:] - p[:-1]
+    return float(np.sqrt(np.sum(diff * diff, axis=-1)).sum())

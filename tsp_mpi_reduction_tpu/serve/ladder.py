@@ -1,0 +1,344 @@
+"""Deadline-aware degradation ladder: certified -> exact-blocked -> greedy.
+
+Every request carries a latency budget. Rather than a fixed solver (and
+either blown deadlines or uniformly weak answers), the service walks a
+ladder of rungs from strongest to cheapest and picks the strongest rung
+whose *estimated* latency fits the remaining budget:
+
+  ``bnb``      certified branch & bound (``models.branch_bound.solve``,
+               time-limited to the budget) — proven optimum or a certified
+               gap from the search's global lower bound;
+  ``pipeline`` the exact vmapped Held-Karp path through the micro-batch
+               scheduler: single block for n <= 16 (exact, gap 0), blocked
+               decomposition + merge fold + device 2-opt/Or-opt polish for
+               larger n (heuristic, no certificate);
+  ``greedy``   host nearest-neighbor — microseconds at serving sizes, the
+               rung that guarantees a valid closed tour for ANY deadline.
+
+Rung latencies are learned online (per-rung, per-size EWMA seeded with
+conservative priors), so the first cold-compile hit teaches the ladder to
+stop promising that rung under tight budgets. A rung that misses its
+budget mid-flight still returns (the response is marked late) — but the
+ladder design keeps that rare: ``greedy`` never misses, and ``pipeline``
+waits on the scheduler only as long as the budget allows before degrading.
+
+Every result records which rung answered (``tier``) and the achieved
+certificate (``certified_gap``) — the cache stores both, so a certified
+answer is never clobbered by a later deadline-degraded one.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from ..ops.distance import distance_matrix_np
+from ..ops.held_karp import MAX_BLOCK_CITIES
+from .scheduler import MicroBatchScheduler
+
+TIERS = ("bnb", "pipeline", "greedy")
+#: strength order for cache-upgrade decisions (higher = stronger rung)
+_TIER_RANK = {"greedy": 0, "pipeline": 1, "bnb": 2}
+
+
+@dataclass
+class LadderResult:
+    cost: float
+    tour: np.ndarray  # [n+1] CLOSED tour in request-space city ids
+    tier: str
+    #: 0.0 = proven/exact; >0 = certified-but-unproven B&B gap; None = no
+    #: certificate (heuristic rung)
+    certified_gap: Optional[float]
+    lower_bound: float = -np.inf
+
+
+@dataclass
+class LadderConfig:
+    #: largest instance the bnb rung will attempt (search is exponential;
+    #: past this the rung is skipped regardless of budget)
+    bnb_max_n: int = 64
+    #: never attempt bnb with less than this many seconds of budget
+    bnb_min_budget_s: float = 1.0
+    #: fraction of the remaining budget handed to bnb's time_limit_s (the
+    #: rest covers setup + response assembly)
+    bnb_budget_fraction: float = 0.6
+    #: conservative cold-start latency priors, refined by the EWMA
+    prior_s: Dict[str, float] = field(
+        default_factory=lambda: {"bnb": 5.0, "pipeline": 0.5, "greedy": 0.0}
+    )
+    #: B&B knobs sized for serving (small instances, bounded memory)
+    bnb_capacity: int = 1 << 14
+    bnb_k: int = 64
+    #: injectable certified solver (tests); signature (d, time_limit_s) ->
+    #: (cost, closed_tour, lower_bound, proven)
+    bnb_solver: Optional[Callable] = None
+    #: 2-opt/Or-opt polish rounds for the blocked-pipeline rung
+    polish_rounds: int = 6
+
+
+class LatencyEstimator:
+    """Per-(tier, n-bucket) EWMA of observed rung latencies."""
+
+    def __init__(self, alpha: float = 0.3) -> None:
+        self.alpha = alpha
+        self._ewma: Dict[Tuple[str, int], float] = {}
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _bucket(n: int) -> int:
+        b = 4
+        while b < n:
+            b *= 2
+        return b
+
+    def observe(self, tier: str, n: int, seconds: float) -> None:
+        key = (tier, self._bucket(n))
+        with self._lock:
+            old = self._ewma.get(key)
+            self._ewma[key] = (
+                seconds if old is None else (1 - self.alpha) * old + self.alpha * seconds
+            )
+
+    def estimate(self, tier: str, n: int, default: float) -> float:
+        with self._lock:
+            return self._ewma.get((tier, self._bucket(n)), default)
+
+
+def _trivial_tour(n: int, d: np.ndarray) -> Tuple[float, np.ndarray]:
+    """n < 3: the only closed tours there are."""
+    if n == 1:
+        return 0.0, np.asarray([0, 0], np.int32)
+    return float(d[0, 1] + d[1, 0]), np.asarray([0, 1, 0], np.int32)
+
+
+def _greedy(d: np.ndarray) -> Tuple[float, np.ndarray]:
+    from ..models.branch_bound import nearest_neighbor_tour
+
+    tour = nearest_neighbor_tour(d)
+    cost = float(d[tour[:-1], tour[1:]].sum())
+    return cost, tour
+
+
+def _largest_block_divisor(n: int) -> Optional[int]:
+    """Largest b in [3, 16] (hard HK cap per SURVEY.md) dividing n."""
+    for b in range(min(n, MAX_BLOCK_CITIES, 16), 2, -1):
+        if n % b == 0:
+            return b
+    return None
+
+
+def _default_bnb_solver(cfg: LadderConfig) -> Callable:
+    from ..models import branch_bound as bb
+
+    def run(d: np.ndarray, time_limit_s: float):
+        res = bb.solve(
+            d,
+            capacity=cfg.bnb_capacity,
+            k=cfg.bnb_k,
+            time_limit_s=max(time_limit_s, 0.05),
+            device_loop=False,  # fine-grained time-limit checks
+        )
+        return res.cost, res.tour, res.lower_bound, bool(res.proven_optimal)
+
+    return run
+
+
+class DeadlineLadder:
+    """Stateful rung dispatcher shared by all request threads."""
+
+    def __init__(
+        self,
+        scheduler: MicroBatchScheduler,
+        cfg: Optional[LadderConfig] = None,
+        estimator: Optional[LatencyEstimator] = None,
+    ) -> None:
+        self.scheduler = scheduler
+        self.cfg = cfg or LadderConfig()
+        self.estimator = estimator or LatencyEstimator()
+        self.tier_counts: Dict[str, int] = {t: 0 for t in TIERS}
+        #: rungs that raised (device OOM, failed batch, solver bug) instead
+        #: of answering — each such request still got a greedy tour
+        self.rung_failures: Dict[str, int] = {t: 0 for t in TIERS}
+        self._count_lock = threading.Lock()
+
+    def _attempt(self, tier: str, n: int, run) -> Optional[LadderResult]:
+        """Run one rung; None on timeout OR exception (the caller degrades).
+
+        The elapsed time is observed in BOTH cases — a rung that burned its
+        budget and failed must teach the estimator, or the ladder will keep
+        promising it to every request (the cold-compile trap). Exceptions
+        are counted, not propagated: the ladder's contract is that a
+        well-formed instance always gets a tour from SOME rung."""
+        t0 = time.monotonic()
+        try:
+            return run()
+        except Exception:  # noqa: BLE001 — degrade, never error
+            with self._count_lock:
+                self.rung_failures[tier] += 1
+            return None
+        finally:
+            self.estimator.observe(tier, n, time.monotonic() - t0)
+
+    def upgrade_eligible(
+        self, n: int, deadline_s: float, entry_tier: str, certified_gap
+    ) -> bool:
+        """Should a cached entry be RE-SOLVED for this request instead of
+        served as a hit? True when the entry is not already exact/proven
+        (``certified_gap == 0.0``) and a STRONGER rung than the one that
+        produced it fits this request's budget — so a greedy answer cached
+        under a tight deadline doesn't pin the instance to greedy forever.
+        (A timed-out bnb certificate with gap > 0 is only re-attempted by
+        a request whose budget fits bnb again.)"""
+        if certified_gap == 0.0:
+            return False
+        if n < 3:
+            return False
+        cfg, est = self.cfg, self.estimator
+        rank = _TIER_RANK.get(entry_tier, 0)
+        if (
+            rank <= _TIER_RANK["bnb"]
+            and n <= cfg.bnb_max_n
+            and deadline_s >= cfg.bnb_min_budget_s
+            and deadline_s >= est.estimate("bnb", n, cfg.prior_s["bnb"])
+        ):
+            return True
+        return rank < _TIER_RANK["pipeline"] and deadline_s >= est.estimate(
+            "pipeline", n, cfg.prior_s["pipeline"]
+        )
+
+    # -- rung implementations ------------------------------------------------
+
+    def _run_bnb(self, d: np.ndarray, budget_s: float) -> LadderResult:
+        solver = self.cfg.bnb_solver or _default_bnb_solver(self.cfg)
+        cost, tour, lb, proven = solver(d, budget_s * self.cfg.bnb_budget_fraction)
+        if proven or cost <= lb:
+            gap = 0.0
+        else:
+            gap = float(max(cost - lb, 0.0) / max(lb, 1e-12)) if np.isfinite(lb) else None
+        return LadderResult(
+            cost=float(cost),
+            tour=np.asarray(tour, np.int32),
+            tier="bnb",
+            certified_gap=gap,
+            lower_bound=float(lb),
+        )
+
+    def _run_pipeline(
+        self, xy: np.ndarray, d: np.ndarray, budget_s: float
+    ) -> Optional[LadderResult]:
+        """Exact HK for one block; blocked HK + merge + polish for larger n.
+        Returns None when the scheduler wait outlives the budget (the
+        caller degrades to greedy; the batch result is discarded)."""
+        n = d.shape[0]
+        if n <= MAX_BLOCK_CITIES:
+            ticket = self.scheduler.submit(d[None])
+            got = ticket.wait(timeout=max(budget_s, 1e-3))
+            if got is None:
+                return None
+            costs, tours = got
+            return LadderResult(
+                cost=float(costs[0]),
+                tour=np.asarray(tours[0], np.int32),
+                tier="pipeline",
+                certified_gap=0.0,  # Held-Karp is exact for a single block
+            )
+        return self._run_blocked(xy, d, budget_s)
+
+    def _run_blocked(
+        self, xy: np.ndarray, d: np.ndarray, budget_s: float
+    ) -> Optional[LadderResult]:
+        """n > 16: spatially-sorted blocked decomposition, the batched HK
+        kernel per block, the repo's merge fold, then device polish. No
+        certificate — the block decomposition is heuristic."""
+        import jax.numpy as jnp
+
+        from ..ops.local_search import polish, tour_length
+        from ..ops.merge import fold_tours
+
+        n = d.shape[0]
+        t0 = time.monotonic()
+        b = _largest_block_divisor(n)
+        if b is None:
+            # prime-ish n: greedy seed + device polish is still a real
+            # improvement rung over raw greedy
+            seed_cost, seed = _greedy(d)
+            order = seed[:-1]
+        else:
+            # block-major spatial order (sort by x, then y) gives blocks
+            # the merge operator can stitch with short splices
+            order = np.lexsort((xy[:, 1], xy[:, 0])).astype(np.int64)
+            blocks = order.reshape(n // b, b)
+            block_d = d[blocks[:, :, None], blocks[:, None, :]]
+            ticket = self.scheduler.submit(block_d)
+            got = ticket.wait(timeout=max(budget_s, 1e-3))
+            if got is None:
+                return None
+            costs, tours = got
+            # fold in global (request-space) ids via the resident matrix
+            global_tours = np.asarray(blocks)[
+                np.arange(blocks.shape[0])[:, None], np.asarray(tours, np.int64)
+            ]
+            ids, length, _cost = fold_tours(
+                jnp.asarray(global_tours, jnp.int32),
+                jnp.asarray(costs),
+                jnp.asarray(d, jnp.float32),
+            )
+            order = np.asarray(ids)[: int(length)][:-1].astype(np.int64)
+        remaining = budget_s - (time.monotonic() - t0)
+        d32 = jnp.asarray(d, jnp.float32)
+        t = jnp.asarray(order, jnp.int32)
+        if remaining > 0:
+            t, _ = polish(t, d32, max_rounds=self.cfg.polish_rounds)
+        cost = float(tour_length(t, d32))
+        open_t = np.asarray(t, np.int64)
+        closed = np.concatenate([open_t, open_t[:1]]).astype(np.int32)
+        return LadderResult(cost=cost, tour=closed, tier="pipeline", certified_gap=None)
+
+    # -- dispatch ------------------------------------------------------------
+
+    def solve(self, xy: np.ndarray, deadline_s: float) -> LadderResult:
+        """Answer one request within ``deadline_s`` (measured from now)."""
+        t_start = time.monotonic()
+        xy = np.asarray(xy, np.float64)
+        n = xy.shape[0]
+        d = distance_matrix_np(xy)
+        cfg, est = self.cfg, self.estimator
+
+        def budget() -> float:
+            return deadline_s - (time.monotonic() - t_start)
+
+        result: Optional[LadderResult] = None
+        if n >= 3:
+            rem = budget()
+            # a rung that throws (device OOM, failed batch, solver bug) must
+            # degrade like a rung that timed out — the ladder's contract is
+            # that a well-formed instance ALWAYS gets a tour, never an error
+            if (
+                n <= cfg.bnb_max_n
+                and rem >= cfg.bnb_min_budget_s
+                and rem >= est.estimate("bnb", n, cfg.prior_s["bnb"])
+            ):
+                result = self._attempt("bnb", n, lambda: self._run_bnb(d, rem))
+            elif budget() >= est.estimate("pipeline", n, cfg.prior_s["pipeline"]):
+                result = self._attempt(
+                    "pipeline", n, lambda: self._run_pipeline(xy, d, budget())
+                )
+        if result is None:
+            # the unconditional rung: valid closed tour at ANY deadline
+            if n < 3:
+                cost, tour = _trivial_tour(n, d)
+            else:
+                cost, tour = _greedy(d)
+            result = LadderResult(
+                cost=cost,
+                tour=tour,
+                tier="greedy",
+                certified_gap=0.0 if n < 3 else None,
+            )
+        with self._count_lock:
+            self.tier_counts[result.tier] += 1
+        return result

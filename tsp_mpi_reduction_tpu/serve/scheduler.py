@@ -1,0 +1,272 @@
+"""Async micro-batching scheduler: N pending solves -> one padded vmap call.
+
+The ORCA/Clipper idea (PAPERS.md) applied to the repo's exact block solver:
+request threads :meth:`~MicroBatchScheduler.submit` ``[B, n, n]`` block
+distance stacks and park on a ticket; a single worker thread drains the
+queue, groups pending submissions of the SAME block size ``n`` (oldest
+first — mixed shapes are served in arrival order, never starved), pads the
+concatenated batch up to a compile bucket, and runs ONE
+``solve_blocks_from_dists`` device call for the whole group instead of one
+dispatch per request.
+
+Latency discipline (the "max-wait knob"): the worker flushes as soon as
+``max_batch`` blocks are pending, and otherwise no later than
+``max_wait_ms`` after the OLDEST pending submission arrived — batching can
+add at most ``max_wait_ms`` to any request, never unbounded queueing delay.
+
+Compile discipline: batch sizes are padded up to fixed power-of-two
+``buckets`` (pad lanes replicate the first real block; vmap lanes are
+independent, so real lanes are bit-identical to an unpadded run). Without
+bucketing every distinct batch size would trigger a fresh XLA compile —
+the classic serving recompile storm.
+
+Device work and host readbacks happen ONLY in :meth:`_run_batch`, called
+once per flush from the worker loop — the loop body itself stays free of
+per-iteration device traffic (graftlint R1/R4 discipline).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Deque, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..ops.held_karp import MAX_BLOCK_CITIES
+from ..utils.profiling import PhaseTimer
+
+_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
+
+
+class Ticket:
+    """One pending submission: request threads block on :meth:`wait`."""
+
+    __slots__ = ("dists", "arrived", "_event", "_costs", "_tours", "_error")
+
+    def __init__(self, dists: np.ndarray) -> None:
+        self.dists = dists
+        self.arrived = time.monotonic()
+        self._event = threading.Event()
+        self._costs: Optional[np.ndarray] = None
+        self._tours: Optional[np.ndarray] = None
+        self._error: Optional[BaseException] = None
+
+    def _resolve(self, costs: np.ndarray, tours: np.ndarray) -> None:
+        self._costs, self._tours = costs, tours
+        self._event.set()
+
+    def _fail(self, exc: BaseException) -> None:
+        self._error = exc
+        self._event.set()
+
+    def wait(self, timeout: Optional[float] = None):
+        """Block until solved. Returns ``(costs [B], tours [B, n+1])`` as
+        numpy arrays, raises the worker's exception if the batch failed,
+        or returns ``None`` on timeout (the caller degrades to a lower
+        ladder rung; the batch still completes and is simply discarded)."""
+        if not self._event.wait(timeout):
+            return None
+        if self._error is not None:
+            raise self._error
+        return self._costs, self._tours
+
+
+class MicroBatchScheduler:
+    def __init__(
+        self,
+        max_batch: int = 64,
+        max_wait_ms: float = 2.0,
+        dtype: str = "float32",
+        buckets: Tuple[int, ...] = _BUCKETS,
+        timer: Optional[PhaseTimer] = None,
+    ) -> None:
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self.max_batch = max_batch
+        self.max_wait_s = max_wait_ms / 1000.0
+        self.dtype = dtype
+        self.buckets = tuple(sorted(set(buckets) | {max_batch}))
+        self.timer = timer or PhaseTimer()
+        self._cv = threading.Condition()
+        self._queue: Deque[Ticket] = deque()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = False
+        # -- counters (reported via utils.reporting.service_stats_json) --
+        self.batches = 0  #: device calls issued
+        self.blocks_solved = 0  #: real (non-padding) blocks solved
+        self.padded_blocks = 0  #: total lanes dispatched incl. padding
+        self.queue_depth_hwm = 0  #: max pending blocks ever queued
+        self.full_flushes = 0  #: flushes triggered by max_batch
+        self.wait_flushes = 0  #: flushes triggered by the max-wait knob
+
+    # -- submission ----------------------------------------------------------
+
+    def submit(self, block_dists: np.ndarray) -> Ticket:
+        """Enqueue a ``[B, n, n]`` stack of block distance matrices.
+
+        Validation errors raise HERE, synchronously, so a malformed request
+        can never poison a shared batch."""
+        d = np.asarray(block_dists)
+        if d.ndim != 3 or d.shape[1] != d.shape[2]:
+            raise ValueError(f"expected [B, n, n] block dists, got {d.shape}")
+        n = int(d.shape[1])
+        if not 3 <= n <= MAX_BLOCK_CITIES:
+            raise ValueError(
+                f"block size must be in [3, {MAX_BLOCK_CITIES}], got {n}"
+            )
+        if d.shape[0] < 1:
+            raise ValueError("empty block stack")
+        ticket = Ticket(d)
+        with self._cv:
+            if self._stop:
+                raise RuntimeError("scheduler is closed")
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._worker, name="serve-microbatch", daemon=True
+                )
+                self._thread.start()
+            self._queue.append(ticket)
+            depth = sum(t.dists.shape[0] for t in self._queue)
+            self.queue_depth_hwm = max(self.queue_depth_hwm, depth)
+            self._cv.notify()
+        return ticket
+
+    def close(self) -> None:
+        """Stop the worker; pending tickets are failed, not dropped."""
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+            self._thread = None
+        with self._cv:
+            pending = list(self._queue)
+            self._queue.clear()
+        for t in pending:
+            t._fail(RuntimeError("scheduler closed before solve"))
+
+    def __enter__(self) -> "MicroBatchScheduler":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- worker --------------------------------------------------------------
+
+    def _collect(self) -> Optional[List[Ticket]]:
+        """Under the condition lock: wait for a flushable group and pop it.
+
+        Returns the oldest submission plus every later pending ticket of
+        the same block size, up to ``max_batch`` total blocks; None when
+        shutting down with an empty queue."""
+        with self._cv:
+            while True:
+                if self._queue:
+                    head = self._queue[0]
+                    pending = sum(
+                        t.dists.shape[0]
+                        for t in self._queue
+                        if t.dists.shape[1] == head.dists.shape[1]
+                    )
+                    waited = time.monotonic() - head.arrived
+                    if self._stop or pending >= self.max_batch or waited >= self.max_wait_s:
+                        if pending >= self.max_batch:
+                            self.full_flushes += 1
+                        else:
+                            self.wait_flushes += 1
+                        return self._pop_group(head.dists.shape[1])
+                    # batch still filling: sleep until the oldest request's
+                    # wait budget lapses (or a new submission wakes us)
+                    self._cv.wait(self.max_wait_s - waited)
+                elif self._stop:
+                    return None
+                else:
+                    self._cv.wait()
+
+    def _pop_group(self, n: int) -> List[Ticket]:
+        group: List[Ticket] = []
+        total = 0
+        keep: Deque[Ticket] = deque()
+        while self._queue:
+            t = self._queue.popleft()
+            fits = total + t.dists.shape[0] <= self.max_batch
+            # the head ticket is taken even when it alone exceeds max_batch
+            # (an oversized submission must flush, not starve the queue)
+            if t.dists.shape[1] == n and (fits or not group):
+                group.append(t)
+                total += t.dists.shape[0]
+            else:
+                keep.append(t)
+        self._queue.extendleft(reversed(keep))
+        return group
+
+    def _worker(self) -> None:
+        while True:
+            group = self._collect()
+            if group is None:
+                return
+            self._run_batch(group)
+
+    def _bucket(self, total: int) -> int:
+        for b in self.buckets:
+            if b >= total:
+                return b
+        return total  # above every bucket: dispatch exact (rare by config)
+
+    def _run_batch(self, group: List[Ticket]) -> None:
+        """ONE device call for the whole same-shape group, then scatter the
+        results back to each ticket. All jnp work and the single host
+        readback of the service's hot path live here."""
+        import jax.numpy as jnp
+
+        from ..ops.held_karp import solve_blocks_from_dists
+
+        try:
+            stacked = np.concatenate([t.dists for t in group], axis=0)
+            total = stacked.shape[0]
+            bucket = self._bucket(total)
+            if bucket > total:
+                pad = np.broadcast_to(
+                    stacked[:1], (bucket - total,) + stacked.shape[1:]
+                )
+                stacked = np.concatenate([stacked, pad], axis=0)
+            dtype = jnp.dtype(self.dtype)
+            with self.timer.phase("serve.batch_solve"):
+                costs, tours = solve_blocks_from_dists(
+                    jnp.asarray(stacked, dtype), dtype
+                )
+                costs_np = np.asarray(costs)
+                tours_np = np.asarray(tours)
+            self.batches += 1
+            self.blocks_solved += total
+            self.padded_blocks += bucket
+            off = 0
+            for t in group:
+                b = t.dists.shape[0]
+                t._resolve(costs_np[off : off + b], tours_np[off : off + b])
+                off += b
+        except BaseException as exc:  # noqa: BLE001 — tickets must not hang
+            for t in group:
+                t._fail(exc)
+
+    # -- stats ---------------------------------------------------------------
+
+    def stats(self) -> Dict[str, float]:
+        return {
+            "batches": self.batches,
+            "blocks_solved": self.blocks_solved,
+            "padded_blocks": self.padded_blocks,
+            # occupancy: real blocks per dispatched lane (1.0 = no padding)
+            "batch_occupancy": (
+                self.blocks_solved / self.padded_blocks if self.padded_blocks else 0.0
+            ),
+            # mean real blocks per device call (the micro-batching win)
+            "mean_batch_blocks": (
+                self.blocks_solved / self.batches if self.batches else 0.0
+            ),
+            "queue_depth_hwm": self.queue_depth_hwm,
+            "full_flushes": self.full_flushes,
+            "wait_flushes": self.wait_flushes,
+        }

@@ -1,0 +1,316 @@
+"""The batched solve service: JSONL requests in, JSONL responses out.
+
+Request schema (one JSON object per line):
+
+    {"id": "r1",                      # optional, echoed back
+     "xy": [[x0, y0], [x1, y1], ...], # [n, 2] city coordinates (required)
+     "deadline_ms": 250.0}            # optional latency budget
+
+Response schema (one JSON object per line, same order as requests):
+
+    {"id": "r1", "n": 12,
+     "cost": 123.4,                  # measured cost of the returned tour
+     "tour": [0, 5, ..., 0],         # CLOSED tour in the request's city ids
+     "tier": "bnb|pipeline|greedy",  # which ladder rung answered
+     "certified_gap": 0.0,           # 0 proven/exact, >0 certified, null none
+     "cache": "hit|miss|refresh",    # refresh = cached non-exact answer
+                                     #   re-solved by a stronger rung
+                                     #   because this budget allowed it
+     "latency_ms": 1.9,
+     "deadline_ms": 250.0,
+     "deadline_missed": false}
+
+or ``{"id": ..., "error": "..."}`` for malformed requests (never for a
+tight deadline — the greedy rung answers those).
+
+Per request: canonicalize (``serve.canonical``) -> LRU lookup
+(``serve.cache``; a hit relabels the cached canonical tour into this
+request's city order and re-measures its true cost) -> on miss, the
+deadline ladder (``serve.ladder``) solves it, micro-batching exact
+Held-Karp work across concurrent requests (``serve.scheduler``), and the
+canonical solution is cached for every future translation/permutation of
+the same instance.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Dict, IO, Iterable, List, Optional
+
+import numpy as np
+
+from ..utils import reporting
+from ..utils.profiling import PhaseTimer
+from . import canonical as canon
+from .cache import CacheEntry, SolutionCache
+from .ladder import DeadlineLadder, LadderConfig
+from .scheduler import MicroBatchScheduler
+
+
+@dataclass
+class ServiceConfig:
+    cache_capacity: int = 4096
+    quant_step: float = canon.DEFAULT_STEP
+    max_batch: int = 64
+    max_wait_ms: float = 2.0
+    dtype: str = "float32"
+    default_deadline_ms: float = 1000.0
+    threads: int = 8
+    ladder: LadderConfig = field(default_factory=LadderConfig)
+
+
+class SolveService:
+    """Thread-safe request handler; owns the scheduler worker and cache."""
+
+    def __init__(self, cfg: Optional[ServiceConfig] = None) -> None:
+        self.cfg = cfg or ServiceConfig()
+        self.timer = PhaseTimer()  # shared across worker + request threads
+        self.cache = SolutionCache(self.cfg.cache_capacity)
+        self.scheduler = MicroBatchScheduler(
+            max_batch=self.cfg.max_batch,
+            max_wait_ms=self.cfg.max_wait_ms,
+            dtype=self.cfg.dtype,
+            timer=self.timer,
+        )
+        self.ladder = DeadlineLadder(self.scheduler, self.cfg.ladder)
+        self.responses = 0
+        self.errors = 0
+        self.deadline_misses = 0
+        self.refreshes = 0  #: cache entries upgraded by a stronger rung
+        # the counters above are read-modify-written from every request
+        # thread (and errors from run_jsonl's reader thread too) — same
+        # lost-update hazard the PhaseTimer lock exists for
+        self._stats_lock = threading.Lock()
+
+    def _record_error(self) -> None:
+        with self._stats_lock:
+            self.errors += 1
+
+    # -- one request ---------------------------------------------------------
+
+    def handle(self, request: Dict) -> Dict:
+        t0 = time.monotonic()
+        req_id = request.get("id")
+        try:
+            xy = np.asarray(request["xy"], np.float64)
+            deadline_ms = float(
+                request.get("deadline_ms", self.cfg.default_deadline_ms)
+            )
+            with self.timer.phase("serve.canonicalize"):
+                ci = canon.canonicalize(xy, self.cfg.quant_step)
+        except (KeyError, TypeError, ValueError) as e:
+            self._record_error()
+            return {"id": req_id, "error": str(e)}
+
+        entry = self.cache.get(ci.key)
+        # a non-exact cached answer does not pin the instance forever: a
+        # request whose budget fits a STRONGER rung re-solves ("refresh")
+        # and the cache's better-entry policy keeps whichever tour wins
+        upgrade = entry is not None and self.ladder.upgrade_eligible(
+            ci.n, deadline_ms / 1000.0, entry.tier, entry.certified_gap
+        )
+        if entry is not None and not upgrade:
+            tour = canon.from_canonical_tour(entry.tour, ci)
+            cost = canon.tour_length_np(tour, xy)
+            tier, gap, provenance = entry.tier, entry.certified_gap, "hit"
+        else:
+            with self.timer.phase("serve.solve"):
+                res = self.ladder.solve(xy, deadline_ms / 1000.0)
+            tour = res.tour
+            # report (and cache) the re-measured f64 length of the actual
+            # tour, not the solver's f32 device value — the response cost
+            # is then consistent between miss and hit paths
+            cost = canon.tour_length_np(tour, xy)
+            new_entry = CacheEntry(
+                cost=cost,
+                tour=canon.to_canonical_tour(tour, ci),
+                certified_gap=res.certified_gap,
+                tier=res.tier,
+            )
+            self.cache.put(ci.key, new_entry)
+            if entry is not None and entry.better_than(new_entry):
+                # the upgrade attempt lost (e.g. bnb timed out worse than
+                # the cached tour) — serve the cached answer, honestly
+                tour = canon.from_canonical_tour(entry.tour, ci)
+                cost = canon.tour_length_np(tour, xy)
+                tier, gap, provenance = entry.tier, entry.certified_gap, "hit"
+            else:
+                tier, gap = res.tier, res.certified_gap
+                provenance = "refresh" if upgrade else "miss"
+            if upgrade:
+                with self._stats_lock:
+                    self.refreshes += 1
+
+        latency_ms = (time.monotonic() - t0) * 1000.0
+        missed = latency_ms > deadline_ms
+        with self._stats_lock:
+            self.responses += 1
+            if missed:
+                self.deadline_misses += 1
+        return {
+            "id": req_id,
+            "n": int(xy.shape[0]),
+            "cost": float(cost),
+            "tour": [int(c) for c in tour],
+            "tier": tier,
+            "certified_gap": None if gap is None else float(gap),
+            "cache": provenance,
+            "latency_ms": round(latency_ms, 3),
+            "deadline_ms": deadline_ms,
+            "deadline_missed": bool(missed),
+        }
+
+    # -- stats / lifecycle ---------------------------------------------------
+
+    def stats_json(self) -> str:
+        with self._stats_lock:
+            responses, errors = self.responses, self.errors
+            misses, refreshes = self.deadline_misses, self.refreshes
+        return reporting.service_stats_json(
+            responses=responses,
+            errors=errors,
+            deadline_misses=misses,
+            refreshes=refreshes,
+            rung_failures=dict(self.ladder.rung_failures),
+            tier_counts=dict(self.ladder.tier_counts),
+            cache=self.cache.stats(),
+            scheduler=self.scheduler.stats(),
+            phases_s=dict(self.timer.seconds),
+        )
+
+    def close(self) -> None:
+        self.scheduler.close()
+
+    def __enter__(self) -> "SolveService":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def run_jsonl(
+    lines: Iterable[str],
+    out: IO[str],
+    cfg: Optional[ServiceConfig] = None,
+    service: Optional[SolveService] = None,
+) -> SolveService:
+    """Drive a service over a JSONL request stream.
+
+    Requests are submitted to a thread pool as they are read (concurrency
+    is what lets the scheduler batch them); a dedicated writer thread
+    emits responses in INPUT order, flushed per line, AS they complete —
+    an interactive client on a pipe sees each response without waiting
+    for the input stream to end, and memory stays bounded (in-flight
+    requests are capped, written responses are not retained). Returns the
+    (closed) service so callers can read final stats.
+    """
+    import queue as _queue
+
+    svc = service or SolveService(cfg)
+    own = service is None
+    #: (future, ready_response) pairs in input order; None = end of stream
+    pending: "_queue.Queue" = _queue.Queue()
+    # bound the in-flight window so an unbounded input stream cannot pile
+    # up futures faster than the workers drain them
+    window = threading.Semaphore(max(4 * svc.cfg.threads, 16))
+
+    def _writer() -> None:
+        while True:
+            item = pending.get()
+            if item is None:
+                return
+            fut, ready = item
+            if fut is None:
+                resp = ready
+            else:
+                try:
+                    resp = fut.result()
+                except Exception as e:  # noqa: BLE001 — the stream survives
+                    resp = {"id": None, "error": f"internal: {e}"}
+                finally:
+                    window.release()
+            try:
+                out.write(json.dumps(resp) + "\n")
+                out.flush()
+            except Exception:  # noqa: BLE001 — broken sink: keep draining
+                pass  # the queue must drain or the reader deadlocks on window
+
+    writer = threading.Thread(target=_writer, name="serve-writer", daemon=True)
+    writer.start()
+    try:
+        with ThreadPoolExecutor(
+            max_workers=svc.cfg.threads, thread_name_prefix="serve-req"
+        ) as pool:
+            for line in lines:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    req = json.loads(line)
+                    if not isinstance(req, dict):
+                        raise ValueError(f"request must be a JSON object, got {type(req).__name__}")
+                except (json.JSONDecodeError, ValueError) as e:
+                    svc._record_error()
+                    pending.put((None, {"id": None, "error": f"bad request: {e}"}))
+                    continue
+                window.acquire()
+                pending.put((pool.submit(svc.handle, req), None))
+    finally:
+        pending.put(None)
+        writer.join()
+        if own:
+            svc.close()
+    return svc
+
+
+def serve_cli(argv: Optional[List[str]] = None) -> int:
+    """``python -m tsp_mpi_reduction_tpu serve`` — see README "Serving"."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="tsp-tpu serve",
+        description="batched TSP solve service: JSONL requests -> JSONL responses",
+    )
+    ap.add_argument("--in", dest="inp", default="-", metavar="FILE",
+                    help="JSONL request file ('-' = stdin)")
+    ap.add_argument("--out", dest="outp", default="-", metavar="FILE",
+                    help="JSONL response file ('-' = stdout)")
+    ap.add_argument("--backend", default="auto", choices=["auto", "cpu", "tpu"])
+    ap.add_argument("--max-batch", type=int, default=64)
+    ap.add_argument("--max-wait-ms", type=float, default=2.0)
+    ap.add_argument("--cache-size", type=int, default=4096)
+    ap.add_argument("--threads", type=int, default=8)
+    ap.add_argument("--default-deadline-ms", type=float, default=1000.0)
+    ap.add_argument("--stats", action="store_true",
+                    help="print the service stats JSON line to stderr on exit")
+    args = ap.parse_args(argv)
+
+    from ..utils.backend import enable_persistent_cache, select_backend
+
+    platform = select_backend(args.backend)
+    enable_persistent_cache(platform)
+
+    cfg = ServiceConfig(
+        cache_capacity=args.cache_size,
+        max_batch=args.max_batch,
+        max_wait_ms=args.max_wait_ms,
+        threads=args.threads,
+        default_deadline_ms=args.default_deadline_ms,
+    )
+    inp = sys.stdin if args.inp == "-" else open(args.inp)
+    outp = sys.stdout if args.outp == "-" else open(args.outp, "w")
+    try:
+        svc = run_jsonl(inp, outp, cfg)
+    finally:
+        if inp is not sys.stdin:
+            inp.close()
+        if outp is not sys.stdout:
+            outp.close()
+    if args.stats:
+        print(svc.stats_json(), file=sys.stderr)
+    return 0

@@ -120,6 +120,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     t_start = time.perf_counter()
     argv = list(sys.argv[1:] if argv is None else argv)
 
+    if argv and argv[0] == "serve":
+        # serving mode (README "Serving"): JSONL requests in, JSONL
+        # responses out. Dispatched before the reference argv contract —
+        # "serve" can never collide with the 4-positional-ints surface.
+        from ..serve.service import serve_cli
+
+        return serve_cli(argv[1:])
+
     try:
         args = build_parser().parse_args(argv)
     except SystemExit as e:

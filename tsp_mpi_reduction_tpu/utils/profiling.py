@@ -11,6 +11,7 @@ Perfetto for kernel-level TPU timing.
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 from typing import Dict, Iterator, Optional
 
@@ -24,10 +25,17 @@ class PhaseTimer:
     >>> timer.seconds  # {"solve": 0.123}
 
     Re-entering a phase name accumulates (useful across B&B iterations).
+
+    Thread-safe: the serve scheduler's worker thread and its request
+    threads record into one shared timer, so the read-modify-write merge
+    into ``seconds`` holds a lock (the measurement window itself does not —
+    overlapping phases from different threads accumulate independently and
+    can legitimately sum past wall-clock time).
     """
 
     def __init__(self) -> None:
         self.seconds: Dict[str, float] = {}
+        self._lock = threading.Lock()
 
     @contextlib.contextmanager
     def phase(self, name: str) -> Iterator[None]:
@@ -35,9 +43,9 @@ class PhaseTimer:
         try:
             yield
         finally:
-            self.seconds[name] = self.seconds.get(name, 0.0) + (
-                time.perf_counter() - t0
-            )
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self.seconds[name] = self.seconds.get(name, 0.0) + dt
 
 
 @contextlib.contextmanager

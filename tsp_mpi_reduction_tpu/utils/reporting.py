@@ -70,3 +70,35 @@ def metrics_json(
     if elapsed_ms > 0 and dp_transitions:
         payload["dp_transitions_per_sec"] = dp_transitions / (elapsed_ms / 1000.0)
     return json.dumps(payload)
+
+
+def service_stats_json(
+    *,
+    responses: int,
+    errors: int,
+    deadline_misses: int,
+    tier_counts: Dict[str, int],
+    cache: Dict[str, int],
+    scheduler: Dict[str, float],
+    phases_s: Optional[Dict[str, float]] = None,
+    refreshes: int = 0,
+    rung_failures: Optional[Dict[str, int]] = None,
+) -> str:
+    """Machine-readable serve-layer counters (SpillStats-style): per-tier
+    answer counts, cache hit/miss/eviction totals plus the derived hit
+    rate, and the scheduler's batching evidence (queue-depth high-water
+    mark, batch occupancy, flush causes). One JSON line so log scrapers
+    and the serve bench consume it the same way as ``metrics_json``."""
+    lookups = cache.get("hits", 0) + cache.get("misses", 0)
+    payload = {
+        "responses": responses,
+        "errors": errors,
+        "deadline_misses": deadline_misses,
+        "refreshes": refreshes,
+        "rung_failures": rung_failures or {},
+        "tiers": tier_counts,
+        "cache": dict(cache, hit_rate=(cache.get("hits", 0) / lookups) if lookups else 0.0),
+        "scheduler": scheduler,
+        "phases_s": phases_s or {},
+    }
+    return json.dumps(payload)
