@@ -14,9 +14,9 @@ PY ?= python
 CXX ?= g++
 CXXFLAGS ?= -O2 -std=c++11
 
-.PHONY: all lint native oracle test test-fast bench run sweep goldens clean
+.PHONY: all lint chaos native oracle test test-fast bench bench-serve bench-faults run sweep goldens clean
 
-all: lint native oracle
+all: lint native oracle chaos
 
 # --- static analysis: graftlint (JAX-hazard rules R1-R5, see README) plus
 # ruff when available (ruff.toml pins a minimal critical-error set; the
@@ -28,6 +28,13 @@ lint:
 	@if command -v ruff >/dev/null 2>&1; then ruff check .; \
 	else echo "lint: ruff not installed — syntax-only compile check instead"; \
 	$(PY) -m compileall -q tsp_mpi_reduction_tpu tools tests bench.py; fi
+
+# --- chaos suite: one injected fault per run at every resilience seam
+# (tests/test_chaos.py; the TSP_FAULTS registry, README "Fault tolerance").
+# Chained into the default target: a seam without working recovery fails
+# the build, not the incident.
+chaos:
+	$(PY) -m pytest tests/ -q -m chaos -p no:cacheprovider
 
 # --- native C++ runtime (generator, Held-Karp, merge, pipeline) ---
 native:
@@ -62,6 +69,10 @@ bench:
 # hit rate, deadline-ladder behavior -> BENCH_SERVE.json
 bench-serve:
 	TSP_BENCH=serve $(PY) bench.py
+
+# atomic-checkpoint overhead vs the legacy direct write -> BENCH_FAULTS.json
+bench-faults:
+	TSP_BENCH=faults $(PY) bench.py
 
 # reference `make run` analog: same config, 3-rank-shaped merge tree
 run:

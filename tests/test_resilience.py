@@ -1,0 +1,538 @@
+"""Resilience layer: fault registry, crash-safe checkpoint store, retry,
+scheduler watchdog.
+
+The headline property test kills the checkpoint writer at EVERY byte
+offset of the file image (fault-registry truncate mode) and asserts the
+store always hands back the previous valid snapshot — the exact failure
+the legacy bare ``np.savez_compressed`` could not survive.
+"""
+
+import io
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tsp_mpi_reduction_tpu.resilience import checkpoint as ck
+from tsp_mpi_reduction_tpu.resilience import faults
+from tsp_mpi_reduction_tpu.resilience.faults import FaultInjected, TransientFault
+from tsp_mpi_reduction_tpu.resilience.health import HEALTH
+from tsp_mpi_reduction_tpu.resilience.retry import RetryPolicy
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# -- fault spec grammar --------------------------------------------------------
+
+
+def test_parse_spec_grammar():
+    clauses = faults.parse_spec(
+        "ckpt.write:truncate,nth=2,at=100,seed=7;cache.get:raise,count=3"
+    )
+    assert len(clauses) == 2
+    c0, c1 = clauses
+    assert (c0.seam, c0.mode, c0.nth, c0.at, c0.seed) == (
+        "ckpt.write", "truncate", 2, 100, 7,
+    )
+    assert (c1.seam, c1.mode, c1.count) == ("cache.get", "raise", 3)
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "nosuchseam:raise",            # unregistered seam
+        "ckpt.write:explode",          # unknown mode
+        "ckpt.write",                  # missing mode
+        "ckpt.write:raise,nth=zero",   # unparsable int
+        "ckpt.write:raise,nth=0",      # nth < 1
+        "ckpt.write:raise,wat=1",      # unknown key
+    ],
+)
+def test_parse_spec_rejects_typos_loudly(bad):
+    """A silently-ignored chaos clause would test nothing: every typo is a
+    hard error."""
+    with pytest.raises(ValueError):
+        faults.parse_spec(bad)
+
+
+def test_env_spec_initializes_registry():
+    reg = faults.FaultRegistry("cache.get:raise")
+    with pytest.raises(FaultInjected):
+        reg.fire("cache.get")
+
+
+def test_nth_count_window():
+    faults.configure("cache.get:raise,nth=2,count=2")
+    reg = faults.registry()
+    reg.fire("cache.get")  # hit 1: clean
+    for _ in range(2):  # hits 2-3: armed
+        with pytest.raises(FaultInjected):
+            reg.fire("cache.get")
+    reg.fire("cache.get")  # hit 4: window closed
+    assert reg.hits("cache.get") == 4
+
+
+def test_count_zero_is_unbounded():
+    faults.configure("cache.put:raise,count=0")
+    reg = faults.registry()
+    for _ in range(5):
+        with pytest.raises(FaultInjected):
+            reg.fire("cache.put")
+
+
+def test_unregistered_seam_is_an_error():
+    with pytest.raises(ValueError, match="unregistered"):
+        faults.registry().fire("not.a.seam")
+
+
+def test_truncate_is_deterministic_and_at_is_exact():
+    blob = bytes(range(200))
+    a = faults.FaultRegistry("ckpt.write:truncate,seed=3")
+    b = faults.FaultRegistry("ckpt.write:truncate,seed=3")
+    cut_a, kind = a.filter_bytes("ckpt.write", blob)
+    cut_b, _ = b.filter_bytes("ckpt.write", blob)
+    assert kind == "truncate" and cut_a == cut_b and len(cut_a) < len(blob)
+    exact = faults.FaultRegistry("ckpt.write:truncate,at=17")
+    cut, _ = exact.filter_bytes("ckpt.write", blob)
+    assert cut == blob[:17]
+
+
+def test_corrupt_flips_bytes_but_keeps_length():
+    blob = bytes(1000)
+    reg = faults.FaultRegistry("ckpt.read:corrupt,seed=1")
+    out, kind = reg.filter_bytes("ckpt.read", blob)
+    assert kind == "corrupt" and len(out) == len(blob) and out != blob
+
+
+def test_delay_mode_sleeps_then_passes():
+    faults.configure("ladder.rung:delay,delay_ms=30")
+    t0 = time.monotonic()
+    faults.registry().fire("ladder.rung")  # no raise
+    assert time.monotonic() - t0 >= 0.025
+
+
+def test_injections_count_into_health():
+    before = HEALTH.snapshot()["faults_injected"].get("cache.get", 0)
+    faults.configure("cache.get:raise")
+    with pytest.raises(FaultInjected):
+        faults.registry().fire("cache.get")
+    assert HEALTH.snapshot()["faults_injected"]["cache.get"] == before + 1
+
+
+# -- checkpoint store ----------------------------------------------------------
+
+
+def test_pack_unpack_roundtrip_and_header():
+    payload = b"the campaign state"
+    blob = ck.pack(payload, fingerprint="abc123")
+    header, out = ck.unpack(blob)
+    assert out == payload
+    assert header["fingerprint"] == "abc123"
+    assert header["payload_len"] == len(payload)
+
+
+def test_unpack_detects_truncation_and_corruption():
+    blob = ck.pack(b"x" * 100, fingerprint=None)
+    for cut in (3, len(ck.MAGIC) + 2, len(blob) - 1):
+        with pytest.raises(ck.CheckpointError):
+            ck.unpack(blob[:cut])
+    flipped = bytearray(blob)
+    flipped[-10] ^= 0xFF
+    with pytest.raises(ck.CheckpointError, match="checksum"):
+        ck.unpack(bytes(flipped))
+
+
+def test_unpack_accepts_legacy_bare_npz():
+    buf = io.BytesIO()
+    np.savez_compressed(buf, a=np.arange(3))
+    legacy = buf.getvalue()
+    header, payload = ck.unpack(legacy)
+    assert header is None and payload == legacy
+    z = np.load(io.BytesIO(payload))
+    np.testing.assert_array_equal(z["a"], np.arange(3))
+
+
+def test_write_atomic_rotation_keeps_last_n(tmp_path):
+    path = str(tmp_path / "c.npz")
+    for i in range(5):
+        ck.write_atomic(path, f"snap{i}".encode(), keep=3)
+    _, payload, src, fallbacks = ck.read_with_fallback(path, keep=3)
+    assert (payload, src, fallbacks) == (b"snap4", path, 0)
+    assert ck.unpack(open(path + ".1", "rb").read())[1] == b"snap3"
+    assert ck.unpack(open(path + ".2", "rb").read())[1] == b"snap2"
+    assert not os.path.exists(path + ".3")  # oldest dropped
+
+
+def test_read_falls_back_past_corrupt_newest(tmp_path):
+    path = str(tmp_path / "c.npz")
+    ck.write_atomic(path, b"good-old")
+    ck.write_atomic(path, b"good-new")
+    with open(path, "r+b") as f:  # bit-rot the newest snapshot in place
+        f.seek(-4, os.SEEK_END)
+        f.write(b"\xff\xff\xff\xff")
+    before = HEALTH.get("fallback_restores")
+    header, payload, src, fallbacks = ck.read_with_fallback(path)
+    assert payload == b"good-old" and src == path + ".1" and fallbacks == 1
+    assert HEALTH.get("fallback_restores") == before + 1
+
+
+def test_transient_read_fault_is_retried_not_fallen_back(tmp_path):
+    """One read hiccup must not cost a rotation step of progress: the
+    per-candidate retry absorbs it and the NEWEST snapshot is returned."""
+    path = str(tmp_path / "c.npz")
+    ck.write_atomic(path, b"older")
+    ck.write_atomic(path, b"newer")
+    faults.configure("ckpt.read:raise")  # count=1: one transient hiccup
+    before = HEALTH.get("retries")
+    _, payload, src, fallbacks = ck.read_with_fallback(path)
+    assert (payload, src, fallbacks) == (b"newer", path, 0)
+    assert HEALTH.get("retries") == before + 1
+
+
+def test_persistent_read_fault_falls_back(tmp_path):
+    path = str(tmp_path / "c.npz")
+    ck.write_atomic(path, b"older")
+    ck.write_atomic(path, b"newer")
+    # count=2 defeats the read retry: the candidate is written off and
+    # the store falls back to the previous rotation snapshot
+    faults.configure("ckpt.read:raise,count=2")
+    _, payload, src, _ = ck.read_with_fallback(path)
+    assert payload == b"older" and src == path + ".1"
+
+
+def test_read_raises_when_no_candidate_survives(tmp_path):
+    path = str(tmp_path / "c.npz")
+    with pytest.raises(ck.CheckpointError, match="missing"):
+        ck.read_with_fallback(path)
+
+
+def test_writer_killed_at_every_byte_offset_preserves_previous(tmp_path):
+    """THE crash-safety property: for EVERY byte offset of the file image,
+    a writer killed there (truncate mode publishes the torn image, then
+    crashes) leaves the store able to hand back the full previous
+    snapshot. This is the failure mode that used to destroy a campaign's
+    only checkpoint."""
+    v1, v2 = b"snapshot-one!", b"snapshot-two."
+    image_len = len(ck.pack(v2, fingerprint="deadbeef"))
+    for offset in range(image_len):
+        root = tmp_path / f"o{offset}"
+        root.mkdir()
+        path = str(root / "c.npz")
+        ck.write_atomic(path, v1, fingerprint="deadbeef")
+        faults.configure(f"ckpt.write:truncate,at={offset}")
+        with pytest.raises(FaultInjected):
+            ck.write_atomic(path, v2, fingerprint="deadbeef")
+        faults.clear()
+        header, payload, _src, fallbacks = ck.read_with_fallback(path)
+        assert payload == v1, f"offset {offset}: lost the valid snapshot"
+        assert fallbacks == 1  # torn newest was detected, not trusted
+        assert header["fingerprint"] == "deadbeef"
+
+
+def test_raise_mode_write_crash_leaves_store_untouched(tmp_path):
+    path = str(tmp_path / "c.npz")
+    ck.write_atomic(path, b"only")
+    faults.configure("ckpt.write:raise")
+    with pytest.raises(FaultInjected):
+        ck.write_atomic(path, b"never-lands")
+    faults.clear()
+    assert ck.read_with_fallback(path)[1] == b"only"
+
+
+def test_read_header_and_fingerprint():
+    d1 = np.arange(16, dtype=np.float64).reshape(4, 4)
+    d2 = d1.copy()
+    d2[0, 1] += 1e-9
+    fp1, fp1b, fp2 = (
+        ck.instance_fingerprint(d1),
+        ck.instance_fingerprint(d1.copy()),
+        ck.instance_fingerprint(d2),
+    )
+    assert fp1 == fp1b and fp1 != fp2  # content hash, byte-exact
+
+
+def test_read_header_from_file(tmp_path):
+    path = str(tmp_path / "c.npz")
+    ck.write_atomic(path, b"payload", fingerprint="f00d")
+    header = ck.read_header(path)
+    assert header["fingerprint"] == "f00d"
+    legacy = str(tmp_path / "legacy.npz")
+    buf = io.BytesIO()
+    np.savez_compressed(buf, a=np.arange(2))
+    with open(legacy, "wb") as f:  # graftlint: disable=R6 — fixture setup
+        f.write(buf.getvalue())
+    assert ck.read_header(legacy) is None
+
+
+def test_write_json_atomic(tmp_path):
+    path = str(tmp_path / "artifact.json")
+    ck.write_json_atomic(path, {"ok": True})
+    with open(path) as f:
+        assert json.load(f) == {"ok": True}
+    assert not os.path.exists(path + ".tmp")
+
+
+# -- retry policy --------------------------------------------------------------
+
+
+def test_retry_absorbs_transient_faults_and_counts():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise TransientFault("transient")
+        return "ok"
+
+    before = HEALTH.get("retries")
+    policy = RetryPolicy(max_attempts=3, base_delay_s=0.001, seed=0)
+    assert policy.call(flaky) == "ok"
+    assert len(calls) == 3
+    assert HEALTH.get("retries") == before + 2
+
+
+def test_retry_gives_up_after_max_attempts():
+    policy = RetryPolicy(max_attempts=2, base_delay_s=0.001, seed=0)
+    calls = []
+
+    def always():
+        calls.append(1)
+        raise TransientFault("still down")
+
+    with pytest.raises(TransientFault):
+        policy.call(always)
+    assert len(calls) == 2
+
+
+def test_retry_does_not_touch_non_transient_errors():
+    calls = []
+
+    def boom():
+        calls.append(1)
+        raise ValueError("logic bug")
+
+    with pytest.raises(ValueError):
+        RetryPolicy(max_attempts=5, base_delay_s=0.001, seed=0).call(boom)
+    assert len(calls) == 1  # no retry: this is not a transient fault
+
+
+def test_retry_backoff_is_deterministic_and_bounded():
+    import random
+
+    policy = RetryPolicy(
+        max_attempts=5, base_delay_s=0.01, max_delay_s=0.04, jitter=0.5, seed=42
+    )
+    a = [policy.delay_s(i, random.Random(42)) for i in range(1, 5)]
+    b = [policy.delay_s(i, random.Random(42)) for i in range(1, 5)]
+    assert a == b  # seeded jitter replays byte-identically
+    for i, delay in enumerate(a, start=1):
+        raw = min(0.01 * 2 ** (i - 1), 0.04)
+        assert raw * 0.5 <= delay <= raw
+
+
+def test_retry_respects_wall_budget():
+    t0 = time.monotonic()
+    with pytest.raises(TransientFault):
+        RetryPolicy(
+            max_attempts=100, base_delay_s=0.05, max_delay_s=0.05, jitter=0.0
+        ).call(lambda: (_ for _ in ()).throw(TransientFault("x")), budget_s=0.02)
+    assert time.monotonic() - t0 < 1.0  # gave up on budget, not attempts
+
+
+# -- health counters -----------------------------------------------------------
+
+
+def test_health_snapshot_always_carries_standard_keys():
+    snap = HEALTH.snapshot()
+    for key in ("worker_restarts", "stuck_restarts", "retries",
+                "fallback_restores", "faults_injected"):
+        assert key in snap
+
+
+def test_health_counters_are_thread_safe():
+    h = __import__(
+        "tsp_mpi_reduction_tpu.resilience.health", fromlist=["HealthCounters"]
+    ).HealthCounters()
+
+    def bump():
+        for _ in range(1000):
+            h.incr("retries")
+
+    threads = [threading.Thread(target=bump) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert h.get("retries") == 8000
+
+
+# -- chunked-driver resume fingerprint pre-flight ------------------------------
+
+
+def _load_chunked_module():
+    import importlib.util
+    import pathlib
+
+    tool = pathlib.Path(__file__).resolve().parent.parent / "tools" / "bnb_chunked.py"
+    spec = importlib.util.spec_from_file_location("bnb_chunked_under_test", tool)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_chunked_resume_refuses_wrong_instance(tmp_path):
+    """Satellite: --resume on a checkpoint whose header fingerprint does
+    not match the requested instance must be a clear pre-flight error,
+    not a silently-resumed wrong search."""
+    from tsp_mpi_reduction_tpu.utils import tsplib
+
+    mod = _load_chunked_module()
+    path = str(tmp_path / "c.npz")
+    wrong_d = tsplib.resolve_instance("ulysses16").distance_matrix()
+    ck.write_atomic(path, b"payload", fingerprint=ck.instance_fingerprint(wrong_d))
+    err = mod._verify_resume_fingerprint(path, "burma14")
+    assert "different instance" in err and "burma14" in err
+
+
+def test_chunked_resume_accepts_matching_and_legacy(tmp_path):
+    from tsp_mpi_reduction_tpu.utils import tsplib
+
+    mod = _load_chunked_module()
+    path = str(tmp_path / "c.npz")
+    d = tsplib.resolve_instance("burma14").distance_matrix()
+    ck.write_atomic(path, b"payload", fingerprint=ck.instance_fingerprint(d))
+    assert mod._verify_resume_fingerprint(path, "burma14") == ""
+    # legacy headerless checkpoint: pre-flight defers to the in-chunk check
+    legacy = str(tmp_path / "legacy.npz")
+    buf = io.BytesIO()
+    np.savez_compressed(buf, a=np.arange(2))
+    with open(legacy, "wb") as f:  # graftlint: disable=R6 — fixture setup
+        f.write(buf.getvalue())
+    assert mod._verify_resume_fingerprint(legacy, "burma14") == ""
+    # corrupt newest: not a mismatch — rotation fallback handles it later
+    with open(path, "r+b") as f:
+        f.write(b"\x00\x00")
+    assert mod._verify_resume_fingerprint(path, "burma14") == ""
+
+
+def test_chunked_driver_retries_a_crashed_chunk(tmp_path, monkeypatch, capsys):
+    """A chunk subprocess that dies (killed writer, lapsed grant) is
+    re-run — the crash-safe checkpoint makes the retry resume from the
+    newest valid snapshot — instead of aborting the whole campaign."""
+    import sys as _sys
+
+    mod = _load_chunked_module()
+    calls = []
+    line = json.dumps({
+        "instance": "burma14", "cost": 3323.0, "proven_optimal": True,
+        "lower_bound": 3323.0, "lb_raw": 3323.0, "lb_certified": 3323.0,
+    })
+
+    class _Result:
+        def __init__(self, rc, out):
+            self.returncode, self.stdout, self.stderr = rc, out, ""
+
+    def fake_run(cmd, **kw):
+        calls.append(list(cmd))
+        if len(calls) == 1:
+            return _Result(1, "")  # chunk 1, attempt 1: crashed
+        return _Result(0, line + "\n")
+
+    monkeypatch.setattr(mod.subprocess, "run", fake_run)
+    monkeypatch.setattr(_sys, "argv", [
+        "bnb_chunked", "burma14", "--max-chunks=3",
+        f"--checkpoint={tmp_path}/c.npz", "--chunk-retries=1",
+    ])
+    rc = mod.main()
+    out = capsys.readouterr()
+    assert rc == 0
+    assert len(calls) == 2  # attempt 1 failed, retry answered
+    assert "retrying (1/1)" in out.err
+    summary = json.loads(out.out.strip().splitlines()[-1])
+    assert summary["proven_optimal"] and summary["chunks"] == 1
+
+
+def test_chunked_driver_gives_up_after_retry_budget(tmp_path, monkeypatch, capsys):
+    import sys as _sys
+
+    mod = _load_chunked_module()
+    calls = []
+
+    class _Fail:
+        returncode, stdout, stderr = 1, "", "boom\n"
+
+    monkeypatch.setattr(
+        mod.subprocess, "run", lambda cmd, **kw: (calls.append(1), _Fail())[1]
+    )
+    monkeypatch.setattr(_sys, "argv", [
+        "bnb_chunked", "burma14", "--max-chunks=3",
+        f"--checkpoint={tmp_path}/c.npz", "--chunk-retries=2",
+    ])
+    assert mod.main() == 1
+    assert len(calls) == 3  # 1 attempt + 2 retries, then abort
+
+
+def test_chunked_resume_gate_sees_rotation_snapshots(tmp_path):
+    """A crash inside the store's rotation window leaves the primary path
+    missing but a valid ``.1`` — the driver must treat that as an
+    existing campaign (refuse a fresh run / pass --resume), never as a
+    clean slate that silently restarts from scratch."""
+    mod = _load_chunked_module()
+    path = str(tmp_path / "c.npz")
+    ck.write_atomic(path, b"snap1")
+    ck.write_atomic(path, b"snap2")
+    os.replace(path, path + ".1")  # simulate the mid-rotation crash state
+    cands = mod._ckpt_candidates(path)
+    assert cands == [path + ".1"]
+    # the fingerprint pre-flight also reads the surviving candidate
+    from tsp_mpi_reduction_tpu.utils import tsplib
+
+    d = tsplib.resolve_instance("burma14").distance_matrix()
+    ck.write_atomic(path + "", b"x", fingerprint=ck.instance_fingerprint(d))
+    os.replace(path, path + ".1")
+    assert mod._verify_resume_fingerprint(path, "burma14") == ""
+    assert "different instance" in mod._verify_resume_fingerprint(path, "ulysses16")
+
+
+def test_fire_fast_path_skips_counting_without_clauses():
+    """Production runs (no TSP_FAULTS) must not pay the registry lock per
+    seam crossing; hit counters only accumulate under an active spec."""
+    reg = faults.FaultRegistry(None)
+    reg.fire("cache.get")
+    assert reg.hits("cache.get") == 0  # fast path: untracked
+    with pytest.raises(ValueError):  # seam names still validated
+        reg.fire("not.a.seam")
+    blob, kind = reg.filter_bytes("ckpt.write", b"abc")
+    assert (blob, kind) == (b"abc", None)
+    reg.configure("cache.get:raise,nth=2")
+    reg.fire("cache.get")
+    assert reg.hits("cache.get") == 1  # counting resumes with clauses
+
+
+def test_chunked_driver_retry_respects_campaign_wall_budget(
+    tmp_path, monkeypatch, capsys
+):
+    """A hung chunk must not be retried past --time-limit: the attempt
+    loop bails (and caps the subprocess timeout) on the remaining budget
+    instead of burning chunk_retries x chunk_timeout of grant time."""
+    import sys as _sys
+
+    mod = _load_chunked_module()
+    calls = []
+    monkeypatch.setattr(mod.subprocess, "run", lambda cmd, **kw: calls.append(1))
+    monkeypatch.setattr(_sys, "argv", [
+        "bnb_chunked", "burma14", "--max-chunks=3", "--chunk-retries=5",
+        f"--checkpoint={tmp_path}/c.npz", "--time-limit=0.000001",
+    ])
+    assert mod.main() == 1
+    err = capsys.readouterr().err
+    assert "wall budget exhausted" in err
+    assert calls == []  # no attempt launched past the budget

@@ -5,6 +5,7 @@ restricted per-test so the suite compiles a handful of shapes, not eight.
 """
 
 import threading
+import time
 
 import jax.numpy as jnp
 import numpy as np
@@ -115,3 +116,155 @@ def test_oversized_submission_flushes_alone():
     with MicroBatchScheduler(max_batch=2, max_wait_ms=1.0, buckets=(2, 4)) as s:
         got = s.submit(ds).wait(timeout=60.0)
     assert got is not None and got[0].shape == (3,)
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+)
+def test_watchdog_revives_killed_worker_and_requeues_inflight():
+    """A worker killed mid-flush (the sched.flush fault seam) must not
+    strand its tickets: the watchdog re-queues the in-flight group and a
+    fresh worker generation answers it."""
+    from tsp_mpi_reduction_tpu.resilience import faults
+    from tsp_mpi_reduction_tpu.resilience.health import HEALTH
+
+    faults.configure("sched.flush:raise")
+    try:
+        before = HEALTH.get("worker_restarts")
+        rng = np.random.default_rng(5)
+        ds = _instances(rng, 2)
+        with MicroBatchScheduler(
+            max_batch=2, max_wait_ms=5.0, buckets=(2,),
+            watchdog_interval_s=0.05,
+        ) as s:
+            tickets = [s.submit(ds[i : i + 1]) for i in range(2)]
+            results = [t.wait(timeout=60.0) for t in tickets]
+            stats = s.stats()
+        assert all(r is not None for r in results)
+        ref_costs, _ = solve_blocks_from_dists(
+            jnp.asarray(ds, jnp.float32), jnp.float32
+        )
+        for i, (costs, _tours) in enumerate(results):
+            np.testing.assert_allclose(
+                costs[0], np.asarray(ref_costs)[i], rtol=1e-6
+            )
+        assert stats["worker_restarts"] >= 1
+        assert HEALTH.get("worker_restarts") > before
+    finally:
+        faults.clear()
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+)
+def test_submission_after_worker_death_revives_without_watchdog_tick():
+    """submit() itself checks the worker's pulse — a dead worker found
+    between watchdog ticks is revived synchronously."""
+    from tsp_mpi_reduction_tpu.resilience import faults
+
+    faults.configure("sched.flush:raise")
+    try:
+        rng = np.random.default_rng(6)
+        ds = _instances(rng, 2)
+        # watchdog effectively disabled: only submit() can revive
+        with MicroBatchScheduler(
+            max_batch=1, max_wait_ms=1.0, buckets=(1,),
+            watchdog_interval_s=3600.0,
+        ) as s:
+            t1 = s.submit(ds[0:1])
+            time.sleep(0.3)  # the worker pops t1, hits the seam, and dies
+            t2 = s.submit(ds[1:2])  # revives the worker AND requeues t1
+            r2 = t2.wait(timeout=60.0)
+            r1 = t1.wait(timeout=60.0)
+        assert r2 is not None and r1 is not None
+    finally:
+        faults.clear()
+
+
+def test_ticket_outcome_is_first_writer_wins():
+    """After a watchdog revive two generations can touch one ticket: the
+    first outcome sticks — a stale worker's late failure must not mask a
+    valid replacement result, nor a late duplicate result a real error."""
+    from tsp_mpi_reduction_tpu.serve.scheduler import Ticket
+
+    t = Ticket(np.zeros((1, 4, 4)))
+    t._resolve(np.asarray([1.5]), np.asarray([[0, 1, 2, 3, 0]]))
+    t._fail(RuntimeError("stale generation's late failure"))
+    costs, tours = t.wait(timeout=1.0)  # must NOT raise
+    assert float(costs[0]) == 1.5
+
+    t2 = Ticket(np.zeros((1, 4, 4)))
+    t2._fail(RuntimeError("real failure"))
+    t2._resolve(np.asarray([9.9]), np.asarray([[0, 1, 2, 3, 0]]))
+    with pytest.raises(RuntimeError, match="real failure"):
+        t2.wait(timeout=1.0)
+
+
+def test_spill_fetch_retries_real_transfer_errors(monkeypatch):
+    """The spill readback retry must absorb what flaky hardware actually
+    raises (XlaRuntimeError, OSError), not only injected test faults."""
+    from jaxlib.xla_extension import XlaRuntimeError
+
+    from tsp_mpi_reduction_tpu.models import branch_bound as bb
+
+    assert XlaRuntimeError in bb._TRANSFER_ERRORS
+    assert OSError in bb._TRANSFER_ERRORS
+
+    calls = []
+
+    class _FlakyOnce:
+        def fire(self, seam):
+            calls.append(seam)
+            if len(calls) == 1:
+                raise OSError("transient transfer failure")
+
+    monkeypatch.setattr(bb, "_fault_registry", lambda: _FlakyOnce())
+    out = bb._fetch_live_rows(jnp.arange(12, dtype=jnp.int32).reshape(3, 4), 2)
+    assert out.shape == (2, 4) and len(calls) == 2  # retried, then fetched
+
+
+def test_rung_retry_uses_remaining_budget_not_stale_capture():
+    """A retry after a late transient fault must run with the time
+    actually left, not the originally-captured budget — otherwise one
+    fault nearly doubles the request's wall time past its deadline."""
+    from tsp_mpi_reduction_tpu.resilience.faults import TransientFault
+    from tsp_mpi_reduction_tpu.serve.ladder import DeadlineLadder, LadderConfig
+
+    budgets = []
+
+    def solver(d, time_limit_s):
+        budgets.append(time_limit_s)
+        if len(budgets) == 1:
+            time.sleep(0.15)
+            raise TransientFault("fault surfacing late in the rung")
+        return 1.0, np.asarray([0, 1, 2, 3, 0], np.int32), 1.0, True
+
+    cfg = LadderConfig(
+        bnb_solver=solver, bnb_min_budget_s=0.0,
+        prior_s={"bnb": 0.0, "pipeline": 0.0, "greedy": 0.0},
+        retry_base_delay_s=0.001,
+    )
+    with MicroBatchScheduler() as sched:
+        ladder = DeadlineLadder(sched, cfg)
+        xy = np.asarray([[0.0, 0.0], [1.0, 0.0], [1.0, 1.0], [0.0, 1.0]])
+        t0 = time.monotonic()
+        res = ladder.solve(xy, deadline_s=0.25)
+        elapsed = time.monotonic() - t0
+    assert res.tier == "bnb" and len(budgets) == 2
+    assert budgets[1] < budgets[0] * 0.75  # shrank to the real remainder
+    assert elapsed < 0.5  # nowhere near 2x the deadline
+
+
+def test_stuck_allowance_backs_off_but_stays_capped():
+    """Successive stuck-revives double the watchdog's patience (cold
+    compiles) but cap at 8x — a persistently wedging backend must not
+    grow the allowance until stuck detection is effectively disabled."""
+    s = MicroBatchScheduler(stuck_timeout_s=1.0)
+    try:
+        with s._cv:
+            for _ in range(10):
+                s._revive_locked(stuck=True)
+            assert s._stuck_allowance == 8.0
+            assert s.stuck_restarts == 10
+    finally:
+        s.close()

@@ -26,6 +26,59 @@ import sys
 import tempfile
 import time
 
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def _ckpt_candidates(ckpt_path: str) -> list:
+    """Existing snapshots in the rotation chain, newest first. The gate
+    must look PAST the primary path: a writer killed inside the store's
+    rotation window (old snapshot already shifted to ``.1``, new one not
+    yet renamed in) leaves the primary missing while valid rotation
+    snapshots still hold the campaign — treating that as 'no checkpoint'
+    would silently restart from scratch."""
+    from tsp_mpi_reduction_tpu.resilience import checkpoint as ck
+
+    return [p for p in ck.rotation_paths(ckpt_path) if os.path.exists(p)]
+
+
+def _verify_resume_fingerprint(ckpt_path: str, instance_spec: str) -> str:
+    """Pre-flight for ``--resume-existing``: the checkpoint header carries
+    the instance fingerprint (hash of the distance matrix,
+    ``resilience.checkpoint``), so a checkpoint from a DIFFERENT instance
+    is refused here with a clear error instead of being silently resumed
+    (or exploding deep inside a chunk subprocess). Returns "" when the
+    resume is safe, else the error message. Legacy headerless checkpoints
+    skip the pre-flight — the solver's in-payload fingerprint check still
+    guards them in-chunk."""
+    from tsp_mpi_reduction_tpu.resilience import checkpoint as ck
+    from tsp_mpi_reduction_tpu.utils import tsplib
+
+    header = None
+    for cand in _ckpt_candidates(ckpt_path):
+        try:
+            header = ck.read_header(cand)
+            break
+        except (ck.CheckpointError, OSError):
+            # corrupt/unreadable snapshot: the store's rotation fallback
+            # inside the chunk handles it — not a mismatch; try an older
+            # candidate's header instead
+            continue
+    if not header or not header.get("fingerprint"):
+        return ""
+    try:
+        inst = tsplib.resolve_instance(instance_spec)
+    except (ValueError, OSError) as e:
+        return f"error: cannot resolve instance {instance_spec!r}: {e}"
+    want = ck.instance_fingerprint(inst.distance_matrix())
+    if header["fingerprint"] != want:
+        return (
+            f"error: checkpoint {ckpt_path!r} was written for a different "
+            f"instance (fingerprint {header['fingerprint']} != {want} for "
+            f"{instance_spec!r}) — resuming it would silently continue the "
+            "wrong search; point --checkpoint elsewhere or remove the file"
+        )
+    return ""
+
 
 def main() -> int:
     ap = argparse.ArgumentParser()
@@ -43,6 +96,12 @@ def main() -> int:
     ap.add_argument("--chunk-timeout", type=float, default=3600.0,
                     help="hard per-chunk wall cap (a lapsed chip grant "
                     "can hang a fresh client init forever)")
+    ap.add_argument("--chunk-retries", type=int, default=1,
+                    help="re-run a crashed/hung chunk this many times "
+                    "before aborting the campaign — the crash-safe "
+                    "checkpoint store makes a retry resume from the "
+                    "newest valid snapshot, so a killed writer or a "
+                    "transient grant hiccup costs one chunk, not the run")
     ap.add_argument("--lb-stall-gain", type=float, default=None,
                     help="stop when the certified lower bound gains less "
                     "than this per chunk, averaged over the last "
@@ -57,14 +116,20 @@ def main() -> int:
         tempfile.mkdtemp(prefix="bnb_chunked_"), "chunk.npz"
     )
     ckpt_real = ckpt if ckpt.endswith(".npz") else ckpt + ".npz"
-    if os.path.exists(ckpt_real) and not args.resume_existing:
+    if _ckpt_candidates(ckpt_real) and not args.resume_existing:
         print(
-            f"error: checkpoint {ckpt_real!r} already exists — a fresh run "
-            "would silently continue it; pass --resume-existing to do that "
-            "intentionally, or remove the file",
+            f"error: checkpoint {ckpt_real!r} already exists (or its "
+            "rotation snapshots do) — a fresh run would silently continue "
+            "it; pass --resume-existing to do that intentionally, or "
+            "remove the file(s)",
             file=sys.stderr,
         )
         return 2
+    if _ckpt_candidates(ckpt_real) and args.resume_existing:
+        err = _verify_resume_fingerprint(ckpt_real, args.instance)
+        if err:
+            print(err, file=sys.stderr)
+            return 2
     tool = os.path.join(os.path.dirname(os.path.abspath(__file__)), "bnb_solve.py")
     t0 = time.perf_counter()
     last = None
@@ -72,33 +137,68 @@ def main() -> int:
     stalled = False
     child_env = dict(os.environ)
     for chunk in range(1, args.max_chunks + 1):
-        cmd = [
-            sys.executable, tool, args.instance,
-            "--device-loop=on", f"--max-iters={args.chunk_iters}",
-            f"--checkpoint={ckpt}",
-        ]
-        if os.path.exists(ckpt_real):
-            cmd.append(f"--resume={ckpt}")
-        if args.time_limit is not None:
-            # remaining wall budget is enforced inside the chunk too
-            # (coarsely: between its device dispatches)
-            remaining = args.time_limit - (time.perf_counter() - t0)
-            cmd.append(f"--time-limit={max(remaining, 1.0)}")
-        cmd += passthrough
-        try:
-            r = subprocess.run(
-                cmd, capture_output=True, text=True,
-                timeout=args.chunk_timeout, env=child_env,
+        line = None
+        # a failed attempt is re-run, not fatal: the crash-safe store
+        # guarantees the checkpoint on disk is the newest VALID snapshot
+        # (rotation fallback), so the retry resumes where the crash left
+        # recoverable state — cmd is rebuilt per attempt because the
+        # first crash may have just created the checkpoint to resume
+        for attempt in range(args.chunk_retries + 1):
+            # a retry must never overrun the CAMPAIGN wall budget: a hung
+            # chunk already burned up to chunk_timeout, so both the
+            # bail-out and the subprocess cap track the remaining budget
+            chunk_cap = args.chunk_timeout
+            if args.time_limit is not None:
+                remaining = args.time_limit - (time.perf_counter() - t0)
+                if remaining <= 0:
+                    print(
+                        f"chunk {chunk}: wall budget exhausted "
+                        "(no retry attempted)", file=sys.stderr,
+                    )
+                    break
+                chunk_cap = min(chunk_cap, remaining + 30.0)  # grace: JSON flush
+            cmd = [
+                sys.executable, tool, args.instance,
+                "--device-loop=on", f"--max-iters={args.chunk_iters}",
+                f"--checkpoint={ckpt}",
+            ]
+            if _ckpt_candidates(ckpt_real):
+                # the store's restore falls back through the rotation
+                # chain, so --resume is right even when the primary file
+                # itself was lost to a mid-rotation crash
+                cmd.append(f"--resume={ckpt}")
+            if args.time_limit is not None:
+                # remaining wall budget is enforced inside the chunk too
+                # (coarsely: between its device dispatches)
+                cmd.append(f"--time-limit={max(remaining, 1.0)}")
+            cmd += passthrough
+            retry_note = (
+                f" — retrying ({attempt + 1}/{args.chunk_retries})"
+                if attempt < args.chunk_retries
+                else ""
             )
-        except subprocess.TimeoutExpired:
-            print(f"chunk {chunk}: timed out after {args.chunk_timeout:.0f}s",
-                  file=sys.stderr)
-            return 1
-        sys.stderr.write(r.stderr[-2000:])
-        line = r.stdout.strip().splitlines()[-1] if r.stdout.strip() else ""
-        if r.returncode != 0 or not line.startswith("{"):
-            print(f"chunk {chunk}: solver failed rc={r.returncode}",
-                  file=sys.stderr)
+            try:
+                r = subprocess.run(
+                    cmd, capture_output=True, text=True,
+                    timeout=chunk_cap, env=child_env,
+                )
+            except subprocess.TimeoutExpired:
+                print(
+                    f"chunk {chunk}: timed out after "
+                    f"{chunk_cap:.0f}s{retry_note}",
+                    file=sys.stderr,
+                )
+                continue
+            sys.stderr.write(r.stderr[-2000:])
+            out = r.stdout.strip().splitlines()[-1] if r.stdout.strip() else ""
+            if r.returncode == 0 and out.startswith("{"):
+                line = out
+                break
+            print(
+                f"chunk {chunk}: solver failed rc={r.returncode}{retry_note}",
+                file=sys.stderr,
+            )
+        if line is None:
             return 1
         last = json.loads(line)
         print(line)

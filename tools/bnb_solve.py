@@ -17,6 +17,7 @@ import sys
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
+from tsp_mpi_reduction_tpu.resilience import health as _health  # noqa: E402
 from tsp_mpi_reduction_tpu.utils.backend import select_backend  # noqa: E402
 
 
@@ -102,38 +103,17 @@ def main() -> int:
     from tsp_mpi_reduction_tpu.models import branch_bound as bb
     from tsp_mpi_reduction_tpu.utils import tsplib
 
-    if args.instance in tsplib.EMBEDDED:
-        inst = tsplib.embedded(args.instance)
-    elif args.instance.startswith("random:"):
-        # "random:N[:SEED]" — N-city uniform Euclidean instance with integer
-        # (nint) distances, e.g. the BASELINE stretch config "random:200"
-        import numpy as np
-
-        parts = args.instance.split(":")
-        try:
-            n_cities = int(parts[1])
-            seed = int(parts[2]) if len(parts) > 2 else 0
-            if n_cities < 3:
-                raise ValueError("need at least 3 cities")
-        except (ValueError, IndexError) as e:
-            print(f"error: bad random instance spec {args.instance!r}: {e}",
-                  file=sys.stderr)
-            return 2
-        rng = np.random.default_rng(seed)
-        xy = rng.uniform(0, 1000, (n_cities, 2))
-        inst = tsplib.TSPLIBInstance(
-            name=f"random{n_cities}s{seed}",
-            dimension=n_cities,
-            edge_weight_type="EUC_2D",
-            comment=f"uniform random {n_cities} cities, seed {seed}",
-            coords=xy,
-        )
-    else:
-        try:
-            inst = tsplib.load(args.instance)
-        except OSError as e:
-            print(f"error: cannot read instance: {e}", file=sys.stderr)
-            return 2
+    # one resolver shared with tools/bnb_chunked.py — "random:N[:SEED]"
+    # specs (e.g. the BASELINE stretch config "random:200"), embedded
+    # names, and TSPLIB paths all go through tsplib.resolve_instance
+    try:
+        inst = tsplib.resolve_instance(args.instance)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    except OSError as e:
+        print(f"error: cannot read instance: {e}", file=sys.stderr)
+        return 2
     d = inst.distance_matrix()
 
     if args.ranks > 1:
@@ -242,6 +222,10 @@ def main() -> int:
                 "spill_full_merges": res.spill_full_merges,
                 "spill_bytes_to_host": res.spill_bytes_to_host,
                 "spill_bytes_to_device": res.spill_bytes_to_device,
+                # self-healing telemetry (resilience.health): retries
+                # absorbed at the spill seam, corrupt checkpoints skipped
+                # in favor of older rotation snapshots, injected faults
+                "health": _health.HEALTH.snapshot(),
             }
         )
     )

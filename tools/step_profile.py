@@ -397,8 +397,9 @@ def main() -> int:
         "(<=1/dispatches overstatement)",
         "components": results,
     }
-    with open(args.out, "w") as f:
-        json.dump(out, f, indent=1)
+    from tsp_mpi_reduction_tpu.resilience.checkpoint import write_json_atomic
+
+    write_json_atomic(args.out, out)
     print(json.dumps(out))
     return 0
 

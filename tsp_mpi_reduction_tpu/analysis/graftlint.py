@@ -27,6 +27,14 @@ detectable from the AST:
       computes locals from it, then has an early ``return None`` path that
       writes nothing back — the ``_partition`` ``take==0`` bug class, where
       ``self.chunks`` was cleared and the merged alive rows silently dropped.
+  R6  non-atomic-durable-write: ``open(path, "w")`` / ``np.savez*`` straight
+      to a final path in a scope with no ``os.replace`` — a crash mid-write
+      leaves a truncated artifact a later reader chokes on (the pre-resilience
+      checkpoint bug: a killed ``np.savez_compressed`` destroyed the
+      campaign's only snapshot). Writes to in-memory buffers (``io.BytesIO``)
+      and temp-named paths are exempt, as is any scope that ``os.replace``-
+      publishes (the temp-file-then-rename pattern); use
+      ``resilience.checkpoint.write_json_atomic``/``write_atomic``.
 
 Escape hatches (both are honored, in this order):
 
@@ -53,6 +61,7 @@ import ast
 import io
 import json
 import pathlib
+import re
 import tokenize
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
@@ -63,6 +72,7 @@ RULES = {
     "R3": "Python control flow on a jitted callee's output",
     "R4": "jnp call inside a Python for loop",
     "R5": "early return None drops mutated self state",
+    "R6": "non-atomic write of a durable artifact",
 }
 
 #: functions whose WHOLE body R1 treats as a hot loop: the reservoir
@@ -103,6 +113,23 @@ _SCALAR_CONVERSION_ATTRS = frozenset(
 )
 #: call roots that count as "jnp work" inside a for loop (R4)
 _JNP_ROOTS = frozenset({"jnp", "lax"})
+#: numpy artifact writers that publish durable bytes to a path (R6)
+_DURABLE_NP_WRITES = frozenset(
+    {
+        "np.save",
+        "np.savez",
+        "np.savez_compressed",
+        "numpy.save",
+        "numpy.savez",
+        "numpy.savez_compressed",
+    }
+)
+#: in-memory buffer factories whose "writes" are not durable (R6 exempt)
+_BUFFER_FACTORIES = frozenset(
+    {"io.BytesIO", "BytesIO", "io.StringIO", "StringIO"}
+)
+#: calls that make the enclosing scope an atomic-publish pattern (R6)
+_ATOMIC_PUBLISH_CALLS = frozenset({"os.replace", "os.rename"})
 
 
 @dataclass(frozen=True)
@@ -265,6 +292,9 @@ class _FileLinter(ast.NodeVisitor):
         self.device_names: Set[str] = set()  # assigned from jnp./jax. calls
         self.pulled_names: Set[str] = set()  # assigned from host pulls
         self.tainted: Set[str] = set()  # assigned raw from jitted callees
+        self.buffer_names: Set[str] = set()  # assigned from io.BytesIO etc.
+        #: does the current scope os.replace-publish (the atomic pattern)?
+        self.atomic_scope = self._scope_is_atomic(tree)
 
     # -- reporting ---------------------------------------------------------
 
@@ -304,6 +334,8 @@ class _FileLinter(ast.NodeVisitor):
             self.device_names,
             self.pulled_names,
             self.tainted,
+            self.buffer_names,
+            self.atomic_scope,
         )
         self.scope.append(node.name)
         self.def_lines.append(node.lineno)
@@ -316,6 +348,8 @@ class _FileLinter(ast.NodeVisitor):
         self.device_names = set()
         self.pulled_names = set()
         self.tainted = set()
+        self.buffer_names = set()
+        self.atomic_scope = self._scope_is_atomic(node)
         self._check_r5(node)
         for child in node.body:
             self.visit(child)
@@ -328,6 +362,8 @@ class _FileLinter(ast.NodeVisitor):
             self.device_names,
             self.pulled_names,
             self.tainted,
+            self.buffer_names,
+            self.atomic_scope,
         ) = saved
 
     # -- loops -------------------------------------------------------------
@@ -372,7 +408,12 @@ class _FileLinter(ast.NodeVisitor):
         names = self._target_names(targets)
         if not names:
             return
-        for group in (self.device_names, self.pulled_names, self.tainted):
+        for group in (
+            self.device_names,
+            self.pulled_names,
+            self.tainted,
+            self.buffer_names,
+        ):
             group.difference_update(names)  # rebinding clears prior status
         if self._is_device_producer(value):
             self.device_names.update(names)
@@ -380,6 +421,11 @@ class _FileLinter(ast.NodeVisitor):
             self.pulled_names.update(names)
         if self._is_raw_jitted_call(value):
             self.tainted.update(names)
+        if (
+            isinstance(value, ast.Call)
+            and _dotted(value.func) in _BUFFER_FACTORIES
+        ):
+            self.buffer_names.update(names)
 
     def _is_device_producer(self, node: ast.AST) -> bool:
         if isinstance(node, ast.Call):
@@ -460,7 +506,96 @@ class _FileLinter(ast.NodeVisitor):
                     "device in this function — write the mutated slice back "
                     "in place with buf.at[:k].set(...) instead",
                 )
+        self._check_r6(node, name)
         self.generic_visit(node)
+
+    # -- R6: non-atomic write of a durable artifact --------------------------
+
+    def _scope_is_atomic(self, root: ast.AST) -> bool:
+        """Does this scope's own code os.replace/os.rename — i.e. follow
+        the write-temp-then-publish pattern that makes its writes safe?"""
+        for sub in _walk_own(root):
+            if (
+                isinstance(sub, ast.Call)
+                and _dotted(sub.func) in _ATOMIC_PUBLISH_CALLS
+            ):
+                return True
+        return False
+
+    @staticmethod
+    def _tempish_token(text: str) -> bool:
+        """TOKEN-boundary temp matching: split on non-alphanumerics and
+        require a segment that IS tmp/temp (or a tmp*/tempfile/tempdir
+        compound). Substring matching would silently exempt durable
+        writes through names like ``attempt``/``template``/``temperature``
+        — exactly the false negatives an exemption rule must not have."""
+        for seg in re.split(r"[^a-z0-9]+", text.lower()):
+            if seg in ("tmp", "temp", "tempfile", "tempdir", "mkdtemp", "mkstemp"):
+                return True
+            if seg.startswith("tmp"):  # tmpfile, tmpdir, tmp2, ...
+                return True
+        return False
+
+    def _is_tempish(self, node: ast.AST) -> bool:
+        """Heuristic: does this path expression name a TEMP location?
+        tempfile-derived values, names/attributes/strings with a tmp/temp
+        token — a crash leaves garbage nobody will ever read back."""
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name):
+                if self._tempish_token(sub.id):
+                    return True
+            elif isinstance(sub, ast.Attribute):
+                if self._tempish_token(sub.attr):
+                    return True
+            elif isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                if self._tempish_token(sub.value):
+                    return True
+        return False
+
+    def _check_r6(self, node: ast.Call, name: Optional[str]) -> None:
+        if "R6" not in self.rules or self.atomic_scope:
+            return
+        if name in _DURABLE_NP_WRITES and node.args:
+            target = node.args[0]
+            if self._is_buffer_target(target) or self._is_tempish(target):
+                return
+            self._emit(
+                node,
+                "R6",
+                f"{name}() writes a durable artifact straight to its final "
+                "path — a crash mid-write leaves a truncated file; write to "
+                "a temp file and os.replace() it into place "
+                "(resilience.checkpoint.write_atomic)",
+            )
+        elif name == "open" and node.args:
+            mode = node.args[1] if len(node.args) > 1 else None
+            for kw in node.keywords:
+                if kw.arg == "mode":
+                    mode = kw.value
+            if not (
+                isinstance(mode, ast.Constant)
+                and isinstance(mode.value, str)
+                and any(c in mode.value for c in "wx")
+            ):
+                return
+            if self._is_tempish(node.args[0]):
+                return
+            self._emit(
+                node,
+                "R6",
+                f"open(..., {mode.value!r}) publishes a durable artifact "
+                "non-atomically — a crash mid-write leaves a truncated "
+                "file; write to a temp file and os.replace() it into place "
+                "(resilience.checkpoint.write_json_atomic)",
+            )
+
+    def _is_buffer_target(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.buffer_names
+        return (
+            isinstance(node, ast.Call)
+            and _dotted(node.func) in _BUFFER_FACTORIES
+        )
 
     def _is_device_expr(self, node: ast.AST) -> bool:
         """Heuristic: does this expression name a device buffer?"""

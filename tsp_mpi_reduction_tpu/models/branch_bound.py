@@ -49,6 +49,21 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..analysis import contracts as _contracts
+from ..resilience import checkpoint as _ckpt_store
+from ..resilience.faults import TransientFault as _TransientFault
+from ..resilience.faults import registry as _fault_registry
+from ..resilience.retry import RetryPolicy as _RetryPolicy
+
+#: what the spill-readback retry absorbs: injected faults (chaos) AND the
+#: errors a real flaky device->host transfer raises — XlaRuntimeError from
+#: the runtime, OSError from the remote-relay transport. Bounded at 3
+#: attempts, so a genuine programming error still surfaces in ~15 ms.
+try:
+    from jaxlib.xla_extension import XlaRuntimeError as _XlaRuntimeError
+
+    _TRANSFER_ERRORS: tuple = (_TransientFault, OSError, _XlaRuntimeError)
+except ImportError:  # jaxlib layout drift: keep the portable subset
+    _TRANSFER_ERRORS = (_TransientFault, OSError)
 
 INF = jnp.inf
 
@@ -1449,8 +1464,19 @@ def _fetch_live_rows(nodes: jnp.ndarray, cnt: int) -> np.ndarray:
     graftlint's DEFAULT_HOT_PATHS and carries the repo's one explicit R1
     waiver, marking the accepted transfer exactly where it happens. The
     ``.copy()`` decouples from any zero-copy CPU-backend view so
-    reservoir rows never pin the device buffer alive."""
-    return np.asarray(nodes[:cnt]).copy()  # graftlint: disable=R1 — the one minimal per-spill fetch
+    reservoir rows never pin the device buffer alive.
+
+    The readback is also the ``spill.fetch`` fault seam: a transient
+    transfer failure (or an injected one) is absorbed by a bounded retry
+    instead of killing a multi-hour campaign mid-spill."""
+
+    def pull() -> np.ndarray:
+        _fault_registry().fire("spill.fetch")
+        return np.asarray(nodes[:cnt]).copy()  # graftlint: disable=R1 — the one minimal per-spill fetch
+
+    return _RetryPolicy(
+        max_attempts=3, base_delay_s=0.005, seed=0, retry_on=_TRANSFER_ERRORS
+    ).call(pull)
 
 
 class _Reservoir:
@@ -2851,6 +2877,36 @@ def save(
     packed buffer — the format predates the packed layout and stays
     stable across engine-internal layout changes.
     """
+    payload = _ckpt_payload(
+        fr, inc_cost, inc_tour, d=d, bound=bound, reservoir=reservoir,
+        num_ranks=num_ranks, lb_floor=lb_floor,
+    )
+    # crash-safe publish: npz serialized in memory, then atomically
+    # replaced into the rotation chain with an integrity header — a
+    # writer killed at ANY byte offset can no longer destroy the campaign
+    # (the legacy direct np.savez_compressed could; see resilience/)
+    _ckpt_store.write_atomic(
+        _norm_ckpt_path(path),
+        _ckpt_store.npz_bytes(**payload),
+        fingerprint=(
+            _ckpt_store.instance_fingerprint(d) if d is not None else None
+        ),
+    )
+
+
+def _ckpt_payload(
+    fr: Frontier,
+    inc_cost,
+    inc_tour,
+    d=None,
+    bound=None,
+    reservoir=None,
+    num_ranks: Optional[int] = None,
+    lb_floor: Optional[float] = None,
+) -> dict:
+    """The checkpoint's npz-ready array dict (see :func:`save`). Split out
+    so the faults bench can time the LEGACY direct-write path against the
+    atomic store on byte-identical payloads."""
     # ONE device->host transfer of the packed buffer, then host-side
     # column unpacking (the property views would issue six separate
     # slice/bitcast kernels + transfers through the relay)
@@ -2899,7 +2955,7 @@ def save(
         res_fields = _unpack_rows_np(np.concatenate(reservoir.chunks))
         for f in CKPT_NODE_FIELDS:
             payload[f"res_{f}"] = res_fields[f]
-    np.savez_compressed(_norm_ckpt_path(path), **payload)
+    return payload
 
 
 def restore(
@@ -2915,8 +2971,29 @@ def restore(
     the reservoir is empty unless the checkpoint carried spilled nodes;
     ``lb_certified`` is the saved certified-LB floor (-inf for
     checkpoints predating the key), which resuming solvers clamp their
-    reported lower bound to."""
-    z = np.load(_norm_ckpt_path(path))
+    reported lower bound to.
+
+    Integrity failures (truncation, checksum mismatch) do NOT raise: the
+    store falls back to the newest VALID snapshot in the rotation chain
+    (``path.1``, ``path.2``, ...), counting ``HEALTH.fallback_restores``.
+    SEMANTIC mismatches (different instance / ranks / bound) still raise —
+    those checkpoints are intact, just wrong to resume."""
+    import io as _io
+
+    header, payload, _src, _fallbacks = _ckpt_store.read_with_fallback(
+        _norm_ckpt_path(path)
+    )
+    if (
+        expect_d is not None
+        and header is not None
+        and header.get("fingerprint")
+        and header["fingerprint"] != _ckpt_store.instance_fingerprint(expect_d)
+    ):
+        raise ValueError(
+            f"checkpoint {path!r} was written for a different instance "
+            "(header fingerprint mismatch)"
+        )
+    z = np.load(_io.BytesIO(payload))
     saved_ranks = int(z["num_ranks"]) if "num_ranks" in z else None
     if saved_ranks != expect_ranks:
         raise ValueError(

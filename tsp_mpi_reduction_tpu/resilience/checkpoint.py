@@ -1,0 +1,257 @@
+"""Crash-safe checkpoint store: atomic publish, integrity header, rotation.
+
+The legacy B&B checkpoint path was a bare ``np.savez_compressed(path)`` —
+a crash mid-write left a truncated file that ``np.load`` could not read,
+and the campaign's only snapshot was gone. This store fixes all three
+failure classes:
+
+- **atomicity**: payloads are written to a same-directory temp file,
+  fsync'd, and ``os.replace``'d into place — a reader never observes a
+  half-written final path;
+- **integrity**: every file carries a header (magic, format version,
+  instance fingerprint, payload length, blake2b checksum) so a torn or
+  bit-rotted snapshot is DETECTED on read instead of exploding inside
+  ``np.load`` — or worse, resuming silently wrong;
+- **rotation**: the last ``keep`` good snapshots are retained
+  (``path``, ``path.1``, ..., newest first), and
+  :func:`read_with_fallback` walks them newest-to-oldest, returning the
+  newest VALID snapshot instead of raising on the first corrupt one.
+  Each fallback counts into ``HEALTH.fallback_restores``.
+
+File layout::
+
+    b"TSPCKPT1" | u32 header_len | header JSON | payload bytes
+
+Legacy headerless files (bare ``.npz``, zip magic ``PK``) are still
+readable — integrity is then whatever ``np.load`` can make of them.
+
+Fault seams: ``ckpt.write`` filters the full file image before publish
+(``truncate`` publishes the torn image THEN raises, modeling a writer
+killed after the rename was queued; ``corrupt`` publishes silently);
+``ckpt.read`` filters each candidate's bytes during restore.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import struct
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .faults import FaultInjected, registry
+from .health import HEALTH
+
+MAGIC = b"TSPCKPT1"
+FORMAT_VERSION = 1
+#: rotation depth: how many good snapshots survive (env-overridable)
+DEFAULT_KEEP = 3
+_LEGACY_ZIP_MAGIC = b"PK"
+
+
+class CheckpointError(RuntimeError):
+    """A checkpoint file failed integrity verification."""
+
+
+def default_keep() -> int:
+    try:
+        return max(1, int(os.environ.get("TSP_CKPT_KEEP", DEFAULT_KEEP)))
+    except ValueError:
+        return DEFAULT_KEEP
+
+
+def instance_fingerprint(d) -> str:
+    """Content hash of a distance matrix: shape + exact float64 bytes.
+    Deterministic across processes, so a resumed chunk can verify it is
+    continuing the SAME instance before any solver state is touched."""
+    a = np.ascontiguousarray(np.asarray(d, np.float64))
+    h = hashlib.blake2b(digest_size=16)
+    h.update(str(a.shape).encode())
+    h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def _checksum(payload: bytes) -> str:
+    return hashlib.blake2b(payload, digest_size=16).hexdigest()
+
+
+def pack(payload: bytes, fingerprint: Optional[str] = None) -> bytes:
+    header = json.dumps(
+        {
+            "version": FORMAT_VERSION,
+            "fingerprint": fingerprint,
+            "payload_len": len(payload),
+            "checksum": _checksum(payload),
+        }
+    ).encode()
+    return MAGIC + struct.pack(">I", len(header)) + header + payload
+
+
+def _parse_header(blob: bytes) -> Tuple[Optional[Dict], int]:
+    """Returns ``(header, payload_offset)``; header None for legacy bare
+    npz. Raises CheckpointError on a torn/unrecognized image."""
+    if blob[:2] == _LEGACY_ZIP_MAGIC:
+        return None, 0
+    if len(blob) < len(MAGIC) + 4 or blob[: len(MAGIC)] != MAGIC:
+        raise CheckpointError("unrecognized checkpoint image (bad magic)")
+    (hlen,) = struct.unpack(">I", blob[len(MAGIC) : len(MAGIC) + 4])
+    start = len(MAGIC) + 4
+    if len(blob) < start + hlen:
+        raise CheckpointError("truncated checkpoint header")
+    try:
+        header = json.loads(blob[start : start + hlen])
+    except (ValueError, UnicodeDecodeError) as e:
+        raise CheckpointError(f"unparseable checkpoint header: {e}") from None
+    if header.get("version") != FORMAT_VERSION:
+        raise CheckpointError(
+            f"unsupported checkpoint format version {header.get('version')!r}"
+        )
+    return header, start + hlen
+
+
+def unpack(blob: bytes) -> Tuple[Optional[Dict], bytes]:
+    """Verify and split a file image into ``(header, payload)``. Raises
+    :class:`CheckpointError` on any integrity violation."""
+    header, off = _parse_header(blob)
+    payload = blob[off:]
+    if header is None:
+        return None, payload
+    if len(payload) != header["payload_len"]:
+        raise CheckpointError(
+            f"truncated checkpoint payload: {len(payload)} bytes, "
+            f"header promises {header['payload_len']}"
+        )
+    if _checksum(payload) != header["checksum"]:
+        raise CheckpointError("checkpoint payload checksum mismatch")
+    return header, payload
+
+
+def read_header(path: str) -> Optional[Dict]:
+    """Header of ``path`` without touching the payload (cheap pre-flight
+    for fingerprint checks). None for legacy headerless files."""
+    with open(path, "rb") as f:
+        prefix = f.read(len(MAGIC) + 4)
+        if prefix[:2] == _LEGACY_ZIP_MAGIC:
+            return None
+        if len(prefix) < len(MAGIC) + 4 or prefix[: len(MAGIC)] != MAGIC:
+            raise CheckpointError("unrecognized checkpoint image (bad magic)")
+        (hlen,) = struct.unpack(">I", prefix[len(MAGIC) :])
+        raw = f.read(hlen)
+    if len(raw) < hlen:
+        raise CheckpointError("truncated checkpoint header")
+    try:
+        return json.loads(raw)
+    except (ValueError, UnicodeDecodeError) as e:
+        raise CheckpointError(f"unparseable checkpoint header: {e}") from None
+
+
+def rotation_paths(path: str, keep: Optional[int] = None) -> List[str]:
+    """Candidate snapshot paths, newest first."""
+    keep = default_keep() if keep is None else keep
+    return [path] + [f"{path}.{i}" for i in range(1, keep)]
+
+
+def write_atomic(
+    path: str,
+    payload: bytes,
+    *,
+    fingerprint: Optional[str] = None,
+    keep: Optional[int] = None,
+) -> None:
+    """Publish a snapshot crash-safely: temp file + fsync + rotation shift
+    + ``os.replace``. The previous ``keep - 1`` good snapshots survive as
+    ``path.1 ... path.{keep-1}``."""
+    keep = default_keep() if keep is None else max(1, keep)
+    blob = pack(payload, fingerprint)
+    blob, injected = registry().filter_bytes("ckpt.write", blob)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    # rotation shift: path -> path.1 -> ... (oldest dropped). Done before
+    # the publish so the newest PREVIOUS snapshot is always recoverable.
+    chain = rotation_paths(path, keep)
+    for older, newer in zip(reversed(chain[1:]), reversed(chain[:-1])):
+        if os.path.exists(newer):
+            os.replace(newer, older)
+    os.replace(tmp, path)
+    _fsync_dir(os.path.dirname(os.path.abspath(path)))
+    if injected == "truncate":
+        # the torn image reached the final path (writer "killed" after the
+        # rename was queued) — now crash, as the real failure would
+        raise FaultInjected("ckpt.write", "truncate", registry().hits("ckpt.write"))
+
+
+def _fsync_dir(dirname: str) -> None:
+    try:
+        fd = os.open(dirname, os.O_RDONLY)
+    except OSError:
+        return  # platform without directory fds: best effort
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def read_with_fallback(
+    path: str, *, keep: Optional[int] = None
+) -> Tuple[Optional[Dict], bytes, str, int]:
+    """Read the newest VALID snapshot in the rotation chain.
+
+    Returns ``(header, payload, source_path, fallbacks)`` where
+    ``fallbacks`` counts newer candidates that were skipped as missing or
+    corrupt. Raises :class:`CheckpointError` when no candidate survives
+    verification (the per-candidate reasons are in the message)."""
+    from .retry import RetryPolicy
+
+    def read_candidate(cand: str) -> bytes:
+        with open(cand, "rb") as f:
+            blob = f.read()
+        return registry().filter_bytes("ckpt.read", blob)[0]
+
+    # a TRANSIENT read failure (flaky storage, an injected ckpt.read
+    # raise) is retried before the candidate is written off — falling
+    # back a rotation step over a hiccup would silently discard progress
+    read_retry = RetryPolicy(max_attempts=2, base_delay_s=0.005, seed=0)
+    failures: List[str] = []
+    for idx, cand in enumerate(rotation_paths(path, keep)):
+        try:
+            blob = read_retry.call(lambda c=cand: read_candidate(c))
+            header, payload = unpack(blob)
+        except FileNotFoundError:
+            failures.append(f"{cand}: missing")
+            continue
+        except (CheckpointError, OSError, FaultInjected) as e:
+            failures.append(f"{cand}: {e}")
+            continue
+        if idx > 0:
+            HEALTH.incr("fallback_restores")
+        return header, payload, cand, idx
+    raise CheckpointError(
+        f"no valid checkpoint in rotation of {path!r}: " + "; ".join(failures)
+    )
+
+
+def write_json_atomic(path: str, obj, *, indent: Optional[int] = 1) -> None:
+    """Atomic publish for durable JSON artifacts (bench/profile outputs):
+    the graftlint-R6-sanctioned replacement for ``open(path, "w")``."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=indent)
+        f.write("\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def npz_bytes(**arrays) -> bytes:
+    """Serialize arrays to in-memory ``.npz`` bytes (the store's payload)."""
+    buf = io.BytesIO()
+    np.savez_compressed(buf, **arrays)
+    return buf.getvalue()

@@ -1,0 +1,60 @@
+"""Process-global self-healing telemetry (the ``health`` counter block).
+
+Every recovery action the resilience subsystem takes — a serve worker
+restarted by the watchdog, a transient fault absorbed by a retry, a
+corrupt checkpoint skipped in favor of an older valid snapshot, a fault
+actually injected by the registry — increments a counter here.
+``utils.reporting.service_stats_json`` and ``tools/bnb_solve.py`` surface
+the block, so a chaos run (or a production incident) leaves a
+machine-readable trace of what self-healed, not just a green exit code.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+
+class HealthCounters:
+    """Thread-safe named counters + a per-seam injected-fault tally."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {}
+        self._faults: Dict[str, int] = {}
+
+    def incr(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + n
+
+    def incr_fault(self, seam: str) -> None:
+        with self._lock:
+            self._faults[seam] = self._faults.get(seam, 0) + 1
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._counts.get(name, 0)
+
+    def snapshot(self) -> Dict:
+        """One JSON-ready dict: the standard counters (always present, so
+        scrapers see explicit zeros) plus any ad-hoc ones and the per-seam
+        injected-fault map."""
+        with self._lock:
+            out: Dict = {
+                "worker_restarts": 0,
+                "stuck_restarts": 0,
+                "retries": 0,
+                "fallback_restores": 0,
+            }
+            out.update(self._counts)
+            out["faults_injected"] = dict(self._faults)
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts.clear()
+            self._faults.clear()
+
+
+#: the process-global instance every layer reports into.
+HEALTH = HealthCounters()

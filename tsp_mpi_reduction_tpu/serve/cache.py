@@ -21,6 +21,8 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from ..resilience.faults import registry as _fault_registry
+
 
 @dataclass(frozen=True)
 class CacheEntry:
@@ -64,6 +66,10 @@ class SolutionCache:
         self.evictions = 0
 
     def get(self, key: str) -> Optional[CacheEntry]:
+        # fault seam OUTSIDE the lock (a delay-mode fault must not wedge
+        # every other request thread); the service absorbs transient
+        # lookup faults by retrying, then degrades to a cache miss
+        _fault_registry().fire("cache.get")
         with self._lock:
             entry = self._entries.get(key)
             if entry is None:
@@ -74,6 +80,7 @@ class SolutionCache:
             return entry
 
     def put(self, key: str, entry: CacheEntry) -> None:
+        _fault_registry().fire("cache.put")
         with self._lock:
             old = self._entries.get(key)
             if old is None or entry.better_than(old):

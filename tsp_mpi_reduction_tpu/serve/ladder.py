@@ -38,6 +38,8 @@ import numpy as np
 
 from ..ops.distance import distance_matrix_np
 from ..ops.held_karp import MAX_BLOCK_CITIES
+from ..resilience.faults import registry as _fault_registry
+from ..resilience.retry import RetryPolicy
 from .scheduler import MicroBatchScheduler
 
 TIERS = ("bnb", "pipeline", "greedy")
@@ -78,6 +80,12 @@ class LadderConfig:
     bnb_solver: Optional[Callable] = None
     #: 2-opt/Or-opt polish rounds for the blocked-pipeline rung
     polish_rounds: int = 6
+    #: transient-fault retries per rung attempt (the self-healing knob:
+    #: a TransientFault/FaultInjected from a rung is re-tried this many
+    #: times with exponential backoff before the ladder degrades)
+    rung_retries: int = 1
+    #: first-retry backoff; doubles per retry, deterministic jitter
+    retry_base_delay_s: float = 0.01
 
 
 class LatencyEstimator:
@@ -165,17 +173,35 @@ class DeadlineLadder:
         self.rung_failures: Dict[str, int] = {t: 0 for t in TIERS}
         self._count_lock = threading.Lock()
 
-    def _attempt(self, tier: str, n: int, run) -> Optional[LadderResult]:
+    def _attempt(
+        self, tier: str, n: int, run, budget_s: Optional[float] = None
+    ) -> Optional[LadderResult]:
         """Run one rung; None on timeout OR exception (the caller degrades).
 
         The elapsed time is observed in BOTH cases — a rung that burned its
         budget and failed must teach the estimator, or the ladder will keep
-        promising it to every request (the cold-compile trap). Exceptions
-        are counted, not propagated: the ladder's contract is that a
-        well-formed instance always gets a tour from SOME rung."""
+        promising it to every request (the cold-compile trap). TRANSIENT
+        faults (``resilience.faults``, incl. the ``ladder.rung`` injection
+        seam) are absorbed by a bounded backoff retry first — capped by
+        ``budget_s`` so a retry can never outspend the request's deadline
+        (``run`` must re-read the remaining budget itself, not capture a
+        stale value, or the retry re-runs with time that no longer
+        exists). Exhausted retries and real exceptions are counted, not
+        propagated: the ladder's contract is that a well-formed instance
+        always gets a tour from SOME rung."""
         t0 = time.monotonic()
-        try:
+
+        def attempt_once():
+            _fault_registry().fire("ladder.rung")
             return run()
+
+        policy = RetryPolicy(
+            max_attempts=1 + max(self.cfg.rung_retries, 0),
+            base_delay_s=self.cfg.retry_base_delay_s,
+            seed=0,
+        )
+        try:
+            return policy.call(attempt_once, budget_s=budget_s)
         except Exception:  # noqa: BLE001 — degrade, never error
             with self._count_lock:
                 self.rung_failures[tier] += 1
@@ -322,10 +348,20 @@ class DeadlineLadder:
                 and rem >= cfg.bnb_min_budget_s
                 and rem >= est.estimate("bnb", n, cfg.prior_s["bnb"])
             ):
-                result = self._attempt("bnb", n, lambda: self._run_bnb(d, rem))
+                # budget() is re-read INSIDE the lambda: a retry after a
+                # late transient fault must run with the time actually
+                # left, not the full original rem (which would land the
+                # response at ~2x the deadline)
+                result = self._attempt(
+                    "bnb", n,
+                    lambda: self._run_bnb(d, max(budget(), 0.05)),
+                    budget_s=rem,
+                )
             elif budget() >= est.estimate("pipeline", n, cfg.prior_s["pipeline"]):
                 result = self._attempt(
-                    "pipeline", n, lambda: self._run_pipeline(xy, d, budget())
+                    "pipeline", n,
+                    lambda: self._run_pipeline(xy, d, budget()),
+                    budget_s=budget(),
                 )
         if result is None:
             # the unconditional rung: valid closed tour at ANY deadline

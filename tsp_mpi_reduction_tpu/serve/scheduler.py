@@ -23,6 +23,16 @@ the classic serving recompile storm.
 Device work and host readbacks happen ONLY in :meth:`_run_batch`, called
 once per flush from the worker loop — the loop body itself stays free of
 per-iteration device traffic (graftlint R1/R4 discipline).
+
+Self-healing (the resilience layer): the worker thread is supervised by a
+WATCHDOG. A worker that dies (an exception escaping the flush — e.g. the
+``sched.flush`` fault seam) or goes silent mid-batch past
+``stuck_timeout_s`` is replaced: unresolved in-flight tickets are
+re-queued at the FRONT of the queue (arrival order preserved) and a fresh
+worker generation takes over; an abandoned-but-alive worker notices its
+stale generation at the next queue interaction and exits. Restarts count
+into the scheduler stats and the global ``HEALTH`` block — a dead worker
+can no longer silently strand every queued request.
 """
 
 from __future__ import annotations
@@ -35,15 +45,26 @@ from typing import Deque, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..ops.held_karp import MAX_BLOCK_CITIES
+from ..resilience.faults import registry as _fault_registry
+from ..resilience.health import HEALTH
 from ..utils.profiling import PhaseTimer
 
 _BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
 
 
 class Ticket:
-    """One pending submission: request threads block on :meth:`wait`."""
+    """One pending submission: request threads block on :meth:`wait`.
 
-    __slots__ = ("dists", "arrived", "_event", "_costs", "_tours", "_error")
+    Outcomes are FIRST-WRITER-WINS: after a watchdog revive, a ticket can
+    be re-solved by the successor generation while the abandoned worker
+    still holds a reference — whichever outcome lands first sticks, so a
+    stale worker's late failure can never mask a valid replacement result
+    (nor vice versa)."""
+
+    __slots__ = (
+        "dists", "arrived", "_event", "_costs", "_tours", "_error",
+        "_claim", "_done",
+    )
 
     def __init__(self, dists: np.ndarray) -> None:
         self.dists = dists
@@ -52,12 +73,25 @@ class Ticket:
         self._costs: Optional[np.ndarray] = None
         self._tours: Optional[np.ndarray] = None
         self._error: Optional[BaseException] = None
+        self._claim = threading.Lock()
+        self._done = False
+
+    def _take_outcome(self) -> bool:
+        with self._claim:
+            if self._done:
+                return False
+            self._done = True
+            return True
 
     def _resolve(self, costs: np.ndarray, tours: np.ndarray) -> None:
+        if not self._take_outcome():
+            return
         self._costs, self._tours = costs, tours
         self._event.set()
 
     def _fail(self, exc: BaseException) -> None:
+        if not self._take_outcome():
+            return
         self._error = exc
         self._event.set()
 
@@ -81,6 +115,8 @@ class MicroBatchScheduler:
         dtype: str = "float32",
         buckets: Tuple[int, ...] = _BUCKETS,
         timer: Optional[PhaseTimer] = None,
+        watchdog_interval_s: float = 0.2,
+        stuck_timeout_s: float = 30.0,
     ) -> None:
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
@@ -89,10 +125,29 @@ class MicroBatchScheduler:
         self.dtype = dtype
         self.buckets = tuple(sorted(set(buckets) | {max_batch}))
         self.timer = timer or PhaseTimer()
+        self.watchdog_interval_s = watchdog_interval_s
+        self.stuck_timeout_s = stuck_timeout_s
         self._cv = threading.Condition()
         self._queue: Deque[Ticket] = deque()
         self._thread: Optional[threading.Thread] = None
+        self._watchdog: Optional[threading.Thread] = None
         self._stop = False
+        #: tickets popped by the current worker but not yet resolved — what
+        #: the watchdog re-queues when that worker dies or wedges
+        self._inflight: List[Ticket] = []
+        #: worker generation: bumped on every (re)start; a worker whose
+        #: generation is stale has been replaced and must stand down
+        self._gen = 0
+        self._heartbeat = time.monotonic()
+        #: current stuck threshold: doubles after every stuck-revive and
+        #: resets when a batch completes cleanly. The watchdog cannot
+        #: tell a wedged worker from a legitimately long batch (a cold
+        #: XLA compile blocks _run_batch well past any fixed timeout), so
+        #: successive generations get exponentially more patience — a
+        #: genuine wedge is still caught fast, while a long first compile
+        #: costs at most a logarithmic number of duplicate dispatches
+        #: (idempotent tickets keep results correct either way)
+        self._stuck_allowance = stuck_timeout_s
         # -- counters (reported via utils.reporting.service_stats_json) --
         self.batches = 0  #: device calls issued
         self.blocks_solved = 0  #: real (non-padding) blocks solved
@@ -100,6 +155,8 @@ class MicroBatchScheduler:
         self.queue_depth_hwm = 0  #: max pending blocks ever queued
         self.full_flushes = 0  #: flushes triggered by max_batch
         self.wait_flushes = 0  #: flushes triggered by the max-wait knob
+        self.worker_restarts = 0  #: dead workers replaced by the watchdog
+        self.stuck_restarts = 0  #: wedged workers abandoned + replaced
 
     # -- submission ----------------------------------------------------------
 
@@ -122,30 +179,101 @@ class MicroBatchScheduler:
         with self._cv:
             if self._stop:
                 raise RuntimeError("scheduler is closed")
-            if self._thread is None:
-                self._thread = threading.Thread(
-                    target=self._worker, name="serve-microbatch", daemon=True
-                )
-                self._thread.start()
+            self._ensure_threads_locked()
             self._queue.append(ticket)
             depth = sum(t.dists.shape[0] for t in self._queue)
             self.queue_depth_hwm = max(self.queue_depth_hwm, depth)
-            self._cv.notify()
+            self._cv.notify_all()
         return ticket
 
     def close(self) -> None:
-        """Stop the worker; pending tickets are failed, not dropped."""
+        """Stop the worker + watchdog; pending tickets are failed, not
+        dropped (in-flight tickets the worker abandoned included)."""
         with self._cv:
             self._stop = True
             self._cv.notify_all()
-        if self._thread is not None:
-            self._thread.join(timeout=30.0)
-            self._thread = None
+        for th in (self._thread, self._watchdog):
+            if th is not None:
+                th.join(timeout=30.0)
+        self._thread = None
+        self._watchdog = None
         with self._cv:
-            pending = list(self._queue)
+            pending = [t for t in self._inflight if not t._event.is_set()]
+            pending += list(self._queue)
+            self._inflight = []
             self._queue.clear()
         for t in pending:
             t._fail(RuntimeError("scheduler closed before solve"))
+
+    # -- supervision ---------------------------------------------------------
+
+    def _ensure_threads_locked(self) -> None:
+        """Under ``self._cv``: make sure a live worker generation and the
+        watchdog exist. A dead worker found HERE (between watchdog ticks)
+        is revived immediately — submission must never race the interval."""
+        if self._thread is not None and not self._thread.is_alive():
+            self._revive_locked(stuck=False)
+        elif self._thread is None:
+            self._spawn_worker_locked()
+        if self._watchdog is None or not self._watchdog.is_alive():
+            self._watchdog = threading.Thread(
+                target=self._watchdog_loop, name="serve-watchdog", daemon=True
+            )
+            self._watchdog.start()
+
+    def _spawn_worker_locked(self) -> None:
+        self._gen += 1
+        self._heartbeat = time.monotonic()
+        self._thread = threading.Thread(
+            target=self._worker,
+            args=(self._gen,),
+            name=f"serve-microbatch-g{self._gen}",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def _revive_locked(self, stuck: bool) -> None:
+        """Replace the current worker generation: re-queue its unresolved
+        in-flight tickets at the FRONT (arrival order preserved) and spawn
+        a successor. For a STUCK (alive but silent) worker the old thread
+        is abandoned — it exits at its next queue interaction when it sees
+        its stale generation; any late result it still resolves is
+        harmless (tickets resolve idempotently)."""
+        live = [t for t in self._inflight if not t._event.is_set()]
+        self._inflight = []
+        self._queue.extendleft(reversed(live))
+        if stuck:
+            self.stuck_restarts += 1
+            HEALTH.incr("stuck_restarts")
+            # see __init__: compile-vs-wedge. Capped so a PERSISTENTLY
+            # wedging backend can't grow the allowance until stuck
+            # detection is effectively disabled
+            self._stuck_allowance = min(
+                self._stuck_allowance * 2, 8 * self.stuck_timeout_s
+            )
+        else:
+            self.worker_restarts += 1
+            HEALTH.incr("worker_restarts")
+        self._spawn_worker_locked()
+        self._cv.notify_all()
+
+    def _watchdog_loop(self) -> None:
+        with self._cv:
+            while not self._stop:
+                self._cv.wait(self.watchdog_interval_s)
+                if self._stop:
+                    return
+                worker = self._thread
+                if worker is None:
+                    continue
+                if not worker.is_alive():
+                    if self._queue or self._inflight:
+                        self._revive_locked(stuck=False)
+                elif (
+                    self._inflight
+                    and time.monotonic() - self._heartbeat > self._stuck_allowance
+                ):
+                    self._revive_locked(stuck=True)
 
     def __enter__(self) -> "MicroBatchScheduler":
         return self
@@ -155,14 +283,18 @@ class MicroBatchScheduler:
 
     # -- worker --------------------------------------------------------------
 
-    def _collect(self) -> Optional[List[Ticket]]:
+    def _collect(self, gen: int) -> Optional[List[Ticket]]:
         """Under the condition lock: wait for a flushable group and pop it.
 
         Returns the oldest submission plus every later pending ticket of
         the same block size, up to ``max_batch`` total blocks; None when
-        shutting down with an empty queue."""
+        shutting down with an empty queue, or when this worker's
+        generation has been superseded by the watchdog (stand down)."""
         with self._cv:
             while True:
+                if self._gen != gen:
+                    return None
+                self._heartbeat = time.monotonic()
                 if self._queue:
                     head = self._queue[0]
                     pending = sum(
@@ -176,7 +308,9 @@ class MicroBatchScheduler:
                             self.full_flushes += 1
                         else:
                             self.wait_flushes += 1
-                        return self._pop_group(head.dists.shape[1])
+                        group = self._pop_group(head.dists.shape[1])
+                        self._inflight = list(group)
+                        return group
                     # batch still filling: sleep until the oldest request's
                     # wait budget lapses (or a new submission wakes us)
                     self._cv.wait(self.max_wait_s - waited)
@@ -202,12 +336,18 @@ class MicroBatchScheduler:
         self._queue.extendleft(reversed(keep))
         return group
 
-    def _worker(self) -> None:
+    def _worker(self, gen: int) -> None:
         while True:
-            group = self._collect()
+            group = self._collect(gen)
             if group is None:
                 return
             self._run_batch(group)
+            with self._cv:
+                if self._gen == gen:
+                    self._inflight = []
+                    # a clean batch proves the worker healthy: restore
+                    # the base stuck threshold for future batches
+                    self._stuck_allowance = self.stuck_timeout_s
 
     def _bucket(self, total: int) -> int:
         for b in self.buckets:
@@ -223,6 +363,10 @@ class MicroBatchScheduler:
 
         from ..ops.held_karp import solve_blocks_from_dists
 
+        # the sched.flush fault seam sits OUTSIDE the try: an injected
+        # raise escapes and kills the worker thread with the group still
+        # in flight — exactly the failure the watchdog must recover from
+        _fault_registry().fire("sched.flush")
         try:
             stacked = np.concatenate([t.dists for t in group], axis=0)
             total = stacked.shape[0]
@@ -269,4 +413,6 @@ class MicroBatchScheduler:
             "queue_depth_hwm": self.queue_depth_hwm,
             "full_flushes": self.full_flushes,
             "wait_flushes": self.wait_flushes,
+            "worker_restarts": self.worker_restarts,
+            "stuck_restarts": self.stuck_restarts,
         }

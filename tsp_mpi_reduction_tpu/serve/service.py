@@ -44,6 +44,9 @@ from typing import Dict, IO, Iterable, List, Optional
 
 import numpy as np
 
+from ..resilience.faults import TransientFault
+from ..resilience.health import HEALTH
+from ..resilience.retry import RetryPolicy
 from ..utils import reporting
 from ..utils.profiling import PhaseTimer
 from . import canonical as canon
@@ -61,6 +64,10 @@ class ServiceConfig:
     dtype: str = "float32"
     default_deadline_ms: float = 1000.0
     threads: int = 8
+    #: scheduler-worker watchdog cadence and the silence threshold past
+    #: which an alive-but-wedged worker is abandoned and replaced
+    watchdog_interval_s: float = 0.2
+    stuck_timeout_s: float = 30.0
     ladder: LadderConfig = field(default_factory=LadderConfig)
 
 
@@ -76,6 +83,8 @@ class SolveService:
             max_wait_ms=self.cfg.max_wait_ms,
             dtype=self.cfg.dtype,
             timer=self.timer,
+            watchdog_interval_s=self.cfg.watchdog_interval_s,
+            stuck_timeout_s=self.cfg.stuck_timeout_s,
         )
         self.ladder = DeadlineLadder(self.scheduler, self.cfg.ladder)
         self.responses = 0
@@ -90,6 +99,24 @@ class SolveService:
     def _record_error(self) -> None:
         with self._stats_lock:
             self.errors += 1
+
+    # a transient cache fault (the cache.get/cache.put seams) must never
+    # cost a request its answer: retry briefly, then degrade — a failed
+    # lookup becomes a miss (re-solve), a failed insert is dropped (the
+    # next request for the instance just misses too)
+    _cache_retry = RetryPolicy(max_attempts=2, base_delay_s=0.005, seed=0)
+
+    def _cache_get(self, key: str) -> Optional[CacheEntry]:
+        try:
+            return self._cache_retry.call(lambda: self.cache.get(key))
+        except TransientFault:
+            return None
+
+    def _cache_put(self, key: str, entry: CacheEntry) -> None:
+        try:
+            self._cache_retry.call(lambda: self.cache.put(key, entry))
+        except TransientFault:
+            pass
 
     # -- one request ---------------------------------------------------------
 
@@ -107,7 +134,7 @@ class SolveService:
             self._record_error()
             return {"id": req_id, "error": str(e)}
 
-        entry = self.cache.get(ci.key)
+        entry = self._cache_get(ci.key)
         # a non-exact cached answer does not pin the instance forever: a
         # request whose budget fits a STRONGER rung re-solves ("refresh")
         # and the cache's better-entry policy keeps whichever tour wins
@@ -132,7 +159,7 @@ class SolveService:
                 certified_gap=res.certified_gap,
                 tier=res.tier,
             )
-            self.cache.put(ci.key, new_entry)
+            self._cache_put(ci.key, new_entry)
             if entry is not None and entry.better_than(new_entry):
                 # the upgrade attempt lost (e.g. bnb timed out worse than
                 # the cached tour) — serve the cached answer, honestly
@@ -181,6 +208,7 @@ class SolveService:
             cache=self.cache.stats(),
             scheduler=self.scheduler.stats(),
             phases_s=dict(self.timer.seconds),
+            health=HEALTH.snapshot(),
         )
 
     def close(self) -> None:
@@ -302,15 +330,29 @@ def serve_cli(argv: Optional[List[str]] = None) -> int:
         threads=args.threads,
         default_deadline_ms=args.default_deadline_ms,
     )
-    inp = sys.stdin if args.inp == "-" else open(args.inp)
-    outp = sys.stdout if args.outp == "-" else open(args.outp, "w")
-    try:
-        svc = run_jsonl(inp, outp, cfg)
-    finally:
-        if inp is not sys.stdin:
-            inp.close()
-        if outp is not sys.stdout:
-            outp.close()
+    # ExitStack closes BOTH handles deterministically on every path — with
+    # the old two-bare-open form, a failing open of the output leaked the
+    # already-open input, and a mid-stream exception could drop buffered
+    # output lines. The flush in the finally covers the stdout case (not
+    # closed) AND the error path of a file sink before its close.
+    from contextlib import ExitStack
+
+    with ExitStack() as stack:
+        inp = sys.stdin if args.inp == "-" else stack.enter_context(open(args.inp))
+        outp = (
+            sys.stdout
+            if args.outp == "-"
+            # a live JSONL response stream, flushed per line by the writer
+            # thread — atomic publish would defeat its purpose
+            else stack.enter_context(open(args.outp, "w"))  # graftlint: disable=R6
+        )
+        try:
+            svc = run_jsonl(inp, outp, cfg)
+        finally:
+            try:
+                outp.flush()
+            except (OSError, ValueError):
+                pass  # broken pipe / already closed: nothing left to save
     if args.stats:
         print(svc.stats_json(), file=sys.stderr)
     return 0

@@ -83,11 +83,14 @@ def service_stats_json(
     phases_s: Optional[Dict[str, float]] = None,
     refreshes: int = 0,
     rung_failures: Optional[Dict[str, int]] = None,
+    health: Optional[Dict] = None,
 ) -> str:
     """Machine-readable serve-layer counters (SpillStats-style): per-tier
     answer counts, cache hit/miss/eviction totals plus the derived hit
-    rate, and the scheduler's batching evidence (queue-depth high-water
-    mark, batch occupancy, flush causes). One JSON line so log scrapers
+    rate, the scheduler's batching evidence (queue-depth high-water
+    mark, batch occupancy, flush causes), and the self-healing ``health``
+    block (worker restarts, absorbed retries, fallback restores, injected
+    faults — see ``resilience.health``). One JSON line so log scrapers
     and the serve bench consume it the same way as ``metrics_json``."""
     lookups = cache.get("hits", 0) + cache.get("misses", 0)
     payload = {
@@ -100,5 +103,6 @@ def service_stats_json(
         "cache": dict(cache, hit_rate=(cache.get("hits", 0) / lookups) if lookups else 0.0),
         "scheduler": scheduler,
         "phases_s": phases_s or {},
+        "health": health or {},
     }
     return json.dumps(payload)

@@ -258,6 +258,39 @@ def embedded(name: str) -> TSPLIBInstance:
         ) from None
 
 
+def resolve_instance(spec: str) -> TSPLIBInstance:
+    """One instance-spec resolver for every driver (``tools/bnb_solve.py``,
+    ``tools/bnb_chunked.py``): an embedded name, a ``random:N[:SEED]``
+    synthetic spec, or a TSPLIB file path. Raises ValueError for a
+    malformed random spec, OSError for an unreadable path — callers turn
+    both into usage errors. Critically, the SAME resolver in the chunk
+    driver and the chunk solver means fingerprint pre-flight checks
+    (resilience.checkpoint) compare byte-identical distance matrices."""
+    if spec in EMBEDDED:
+        return embedded(spec)
+    if spec.startswith("random:"):
+        parts = spec.split(":")
+        try:
+            n_cities = int(parts[1])
+            seed = int(parts[2]) if len(parts) > 2 else 0
+        except (ValueError, IndexError):
+            raise ValueError(
+                f"bad random instance spec {spec!r}: want random:N[:SEED]"
+            ) from None
+        if n_cities < 3:
+            raise ValueError(f"bad random instance spec {spec!r}: need at least 3 cities")
+        rng = np.random.default_rng(seed)
+        xy = rng.uniform(0, 1000, (n_cities, 2))
+        return TSPLIBInstance(
+            name=f"random{n_cities}s{seed}",
+            dimension=n_cities,
+            edge_weight_type="EUC_2D",
+            comment=f"uniform random {n_cities} cities, seed {seed}",
+            coords=xy,
+        )
+    return load(spec)
+
+
 def _ulysses16_text() -> str:
     """ulysses16 is, by TSPLIB construction, the first 16 ulysses22 cities."""
     from . import tsplib_data
