@@ -14,7 +14,7 @@ PY ?= python
 CXX ?= g++
 CXXFLAGS ?= -O2 -std=c++11
 
-.PHONY: all lint chaos native oracle test test-fast bench bench-serve bench-faults run sweep goldens clean
+.PHONY: all lint chaos native oracle test test-fast bench bench-serve bench-faults bench-compile run sweep goldens clean
 
 all: lint native oracle chaos
 
@@ -73,6 +73,12 @@ bench-serve:
 # atomic-checkpoint overhead vs the legacy direct write -> BENCH_FAULTS.json
 bench-faults:
 	TSP_BENCH=faults $(PY) bench.py
+
+# compile-once acceptance bench: cold vs warm chunk-process startup and
+# serve first-flush latency (fresh subprocesses against one shared
+# TSP_COMPILE_CACHE dir) -> BENCH_COMPILE_CACHE.json
+bench-compile:
+	TSP_BENCH=compile $(PY) bench.py
 
 # reference `make run` analog: same config, 3-rank-shaped merge tree
 run:

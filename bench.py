@@ -1,6 +1,6 @@
 """Benchmark driver. Prints ONE JSON line: {"metric","value","unit","vs_baseline"}.
 
-Four modes, selected by ``TSP_BENCH`` (default ``pipeline``):
+Modes, selected by ``TSP_BENCH`` (default ``pipeline``):
 
 - ``pipeline`` — full blocked pipeline, 16 cities x 100 blocks (headline
   config). Baseline: the unmodified reference solving the same
@@ -30,6 +30,16 @@ Four modes, selected by ``TSP_BENCH`` (default ``pipeline``):
   plus cache-hit rate on permuted/translated resubmission and the
   deadline ladder's behavior under an impossible budget. Also writes the
   ``BENCH_SERVE.json`` artifact (see :func:`bench_serve`).
+
+- ``compile`` — the compile-once acceptance bench (ISSUE 5): cold vs warm
+  process startup against one shared ``TSP_COMPILE_CACHE`` dir, measured
+  in fresh subprocesses (chunk-resume startup through the device loop +
+  serve first-flush latency), with cold/warm result equality asserted.
+  Writes ``BENCH_COMPILE_CACHE.json`` (see :func:`bench_compile`;
+  ``compile-child`` is its internal per-process mode).
+
+- ``faults`` — atomic-checkpoint overhead vs the legacy direct write
+  (ISSUE 4); writes ``BENCH_FAULTS.json`` (see :func:`bench_faults`).
 
 - ``bnb`` — the north-star metric (BASELINE.json): B&B nodes/sec on a
   TSPLIB instance solved to PROVEN optimality. Default instance: eil51
@@ -196,6 +206,201 @@ def bench_faults() -> int:
 
     shutil.rmtree(workdir, ignore_errors=True)
     return 0
+
+
+def bench_compile_child() -> int:
+    """One measured process of the compile bench (``TSP_BENCH=compile-child``).
+
+    Modes (``TSP_BENCH_COMPILE_MODE``):
+      setup — build the resume checkpoint the chunk children share;
+      chunk — a chunk-relay process: resume the checkpoint, run ONE
+              expansion dispatch, report post-import->first-dispatch wall
+              (the startup the relay re-pays per chunk) + the result;
+      serve — a service process: optionally precompile the shape bucket,
+              submit one batch, report the first-flush latency + tours.
+
+    Whether the process is COLD or WARM is entirely the parent's
+    ``TSP_COMPILE_CACHE`` env (off vs a shared populated dir) — the child
+    code is identical, so any result difference would be the cache's
+    fault and is asserted away by the parent.
+    """
+    import time
+
+    t0 = time.perf_counter()  # post-import: bench.py's imports are done
+    mode = os.environ.get("TSP_BENCH_COMPILE_MODE", "chunk")
+    instance = os.environ.get("TSP_BENCH_COMPILE_INSTANCE", "eil51")
+    ck = os.environ["TSP_BENCH_COMPILE_CKPT"]
+    # k sized so the checkpoint capacity satisfies the device-loop floor
+    # (4*k*(n-1) <= 1<<15 at eil51) — chunk children run device_loop=True,
+    # the chunked relay's actual configuration
+    k = int(os.environ.get("TSP_BENCH_COMPILE_K", "64"))
+
+    from tsp_mpi_reduction_tpu.perf import compile_cache as perf_cache
+    from tsp_mpi_reduction_tpu.utils.backend import select_backend
+
+    platform = select_backend(os.environ.get("TSP_BENCH_COMPILE_BACKEND", "auto"))
+    perf_cache.enable(platform)
+
+    if mode == "serve":
+        import numpy as np
+
+        from tsp_mpi_reduction_tpu.serve.scheduler import MicroBatchScheduler
+
+        n = int(os.environ.get("TSP_BENCH_COMPILE_SERVE_N", "8"))
+        blocks = int(os.environ.get("TSP_BENCH_COMPILE_SERVE_B", "16"))
+        rng = np.random.default_rng(7)
+        xy = rng.random((blocks, n, 2)) * 1000.0
+        diff = xy[:, :, None, :] - xy[:, None, :, :]
+        dists = np.sqrt(np.sum(diff * diff, axis=-1))
+        with MicroBatchScheduler(max_batch=blocks, max_wait_ms=1.0) as sched:
+            warm_s = 0.0
+            if os.environ.get("TSP_BENCH_COMPILE_WARMUP") == "1":
+                t_w = time.perf_counter()
+                sched.precompile([n])
+                warm_s = time.perf_counter() - t_w
+            t_f = time.perf_counter()
+            costs, tours = sched.submit(dists).wait(timeout=600.0)
+            flush_s = time.perf_counter() - t_f
+        print(json.dumps({
+            "mode": mode,
+            "startup_s": round(time.perf_counter() - t0, 3),
+            "precompile_s": round(warm_s, 3),
+            "first_flush_s": round(flush_s, 3),
+            "costs": [float(c) for c in costs],
+            "tours": [[int(c) for c in t] for t in tours],
+            "compile_cache": perf_cache.stats_dict(),
+        }))
+        return 0
+
+    from tsp_mpi_reduction_tpu.models import branch_bound as bb
+    from tsp_mpi_reduction_tpu.utils import tsplib
+
+    d = tsplib.resolve_instance(instance).distance_matrix()
+    if mode == "setup":
+        # leave an unproven mid-search checkpoint for the chunk children
+        res = bb.solve(d, capacity=1 << 15, k=k, max_iters=64, ils_rounds=0,
+                       checkpoint_path=ck, device_loop=False)
+        assert not res.proven_optimal, "setup proved early; shrink max_iters"
+        print(json.dumps({"mode": mode, "cost": res.cost}))
+        return 0
+
+    # chunk mode: the relay's per-process startup — resume + ONE dispatch
+    # through the transfer-free device loop (what bnb_chunked.py runs)
+    res = bb.solve(d, k=k, max_iters=1, resume_from=ck, device_loop=True)
+    startup_s = time.perf_counter() - t0
+    print(json.dumps({
+        "mode": mode,
+        "startup_s": round(startup_s, 3),
+        "setup_s": round(res.setup_seconds, 3),
+        "dispatch_s": round(res.wall_seconds, 3),
+        "cost": res.cost,
+        "lb_certified": res.lower_bound,
+        "compile_cache": perf_cache.stats_dict(),
+    }))
+    return 0
+
+
+def bench_compile() -> int:
+    """``TSP_BENCH=compile``: cold vs warm compile-once measurements ->
+    ``BENCH_COMPILE_CACHE.json``.
+
+    Two legs, each measured in fresh subprocesses so "process startup"
+    means exactly what the chunk relay pays:
+
+    - **chunk**: a checkpoint-resume process (the ``bnb_chunked.py``
+      shape) run cold (``TSP_COMPILE_CACHE=off`` — the pre-PR behavior),
+      then twice against one shared cache dir (populate, then the
+      measured WARM start). Warm must be >= 3x faster post-import to
+      first expansion dispatch, with identical cost/certified-LB.
+    - **serve**: first-flush latency of a fresh scheduler process, cold
+      vs warmed (precompile + populated cache), tours bit-identical.
+    """
+    import shutil
+    import subprocess
+    import tempfile
+
+    workdir = tempfile.mkdtemp(prefix="bench_compile_")
+    cache_dir = os.path.join(workdir, "compile_cache")
+    ck = os.path.join(workdir, "seed.npz")
+    out_path = os.environ.get("TSP_BENCH_COMPILE_OUT", "BENCH_COMPILE_CACHE.json")
+    backend = os.environ.get("TSP_BENCH_COMPILE_BACKEND", "auto")
+
+    def run_child(mode: str, cache: str, warmup: bool = False) -> dict:
+        env = dict(
+            os.environ,
+            TSP_BENCH="compile-child",
+            TSP_BENCH_COMPILE_MODE=mode,
+            TSP_BENCH_COMPILE_CKPT=ck,
+            TSP_BENCH_COMPILE_BACKEND=backend,
+            TSP_COMPILE_CACHE=cache,
+        )
+        if warmup:
+            env["TSP_BENCH_COMPILE_WARMUP"] = "1"
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            capture_output=True, text=True, timeout=1800, env=env,
+        )
+        if r.returncode != 0:
+            sys.stderr.write(r.stderr[-2000:])
+            raise RuntimeError(f"compile-bench child {mode} rc={r.returncode}")
+        os.environ["TSP_BACKEND_PROBED"] = "1"  # children share one probe
+        return json.loads(r.stdout.strip().splitlines()[-1])
+
+    try:
+        run_child("setup", "off")
+        cold = run_child("chunk", "off")
+        populate = run_child("chunk", cache_dir)
+        warm = run_child("chunk", cache_dir)
+
+        serve_cold = run_child("serve", "off")
+        run_child("serve", cache_dir, warmup=True)  # populate serve entries
+        serve_warm = run_child("serve", cache_dir, warmup=True)
+    except BaseException:
+        # a failed child must not leak the workdir (seed checkpoint + a
+        # populated executable cache — can be hundreds of MB in /tmp)
+        shutil.rmtree(workdir, ignore_errors=True)
+        raise
+
+    speedup = cold["startup_s"] / warm["startup_s"] if warm["startup_s"] else None
+    artifact = {
+        "metric": "compile_once_warm_start",
+        "unit": "x cold/warm chunk startup",
+        "value": round(speedup, 2) if speedup else None,
+        "instance": os.environ.get("TSP_BENCH_COMPILE_INSTANCE", "eil51"),
+        "backend": backend,
+        "chunk": {
+            "cold_startup_s": cold["startup_s"],
+            "populate_startup_s": populate["startup_s"],
+            "warm_startup_s": warm["startup_s"],
+            "speedup": round(speedup, 2) if speedup else None,
+            "costs_equal": cold["cost"] == warm["cost"] == populate["cost"],
+            "lb_equal": cold["lb_certified"] == warm["lb_certified"],
+            "cost": cold["cost"],
+            "lb_certified": cold["lb_certified"],
+            "warm_compile_cache": warm["compile_cache"],
+        },
+        "serve": {
+            "cold_first_flush_s": serve_cold["first_flush_s"],
+            "warm_first_flush_s": serve_warm["first_flush_s"],
+            "warm_precompile_s": serve_warm["precompile_s"],
+            "flush_speedup": round(
+                serve_cold["first_flush_s"] / serve_warm["first_flush_s"], 2
+            ) if serve_warm["first_flush_s"] else None,
+            "tours_match": serve_cold["tours"] == serve_warm["tours"]
+            and serve_cold["costs"] == serve_warm["costs"],
+        },
+    }
+    from tsp_mpi_reduction_tpu.resilience.checkpoint import write_json_atomic
+
+    write_json_atomic(out_path, artifact)
+    print(json.dumps(artifact))
+    shutil.rmtree(workdir, ignore_errors=True)
+    ok = (
+        artifact["chunk"]["costs_equal"]
+        and artifact["chunk"]["lb_equal"]
+        and artifact["serve"]["tours_match"]
+    )
+    return 0 if ok else 1
 
 
 def bench_bnb() -> int:
@@ -601,6 +806,14 @@ def bench_serve() -> int:
 
 
 def main() -> int:
+    if os.environ.get("TSP_BENCH") == "compile-child":
+        # one measured subprocess of the compile bench (selects its own
+        # backend; the parent passes TSP_BACKEND_PROBED after child 1)
+        return bench_compile_child()
+    if os.environ.get("TSP_BENCH") == "compile":
+        # parent spawner only — must not initialize a jax backend (the
+        # remote-TPU claim is exclusive per process; children claim it)
+        return bench_compile()
     if os.environ.get("TSP_BENCH") == "spill":
         # forces its own CPU virtual mesh — never probes the accelerator
         return bench_spill()

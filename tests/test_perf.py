@@ -1,0 +1,394 @@
+"""Compile-once execution layer (perf/): cache keys, AOT store, donation.
+
+Four surfaces:
+- cache-key invalidation: any change to jax version string, backend,
+  dtype, shape, or a static arg must change the key — a stale executable
+  can never be loaded for a config it was not compiled for;
+- the AOT serialized-executable store: miss -> validated write -> hit,
+  corrupt-file degradation, disabled-cache no-op;
+- buffer donation: ``_expand_loop`` output aliases its input frontier on
+  CPU (pointer identity), the donating spill writebacks alias, and the
+  ``check_donated`` contract distinguishes consumed from live buffers;
+- the host-setup memo + canonicalization fast path + scheduler warmup.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tsp_mpi_reduction_tpu.analysis import contracts
+from tsp_mpi_reduction_tpu.models import branch_bound as bb
+from tsp_mpi_reduction_tpu.perf import compile_cache as cc
+from tsp_mpi_reduction_tpu.perf import donation
+from tsp_mpi_reduction_tpu.utils import tsplib
+
+
+@pytest.fixture
+def perf_dir(tmp_path, monkeypatch):
+    """Enable the perf store into a throwaway dir (no jax.config edits —
+    only the AOT/memo layers, which is what these tests exercise)."""
+    monkeypatch.setattr(cc, "_enabled_dir", str(tmp_path))
+    return tmp_path
+
+
+@pytest.fixture
+def perf_off(monkeypatch):
+    monkeypatch.setattr(cc, "_enabled_dir", None)
+
+
+def _d(name="burma14"):
+    return tsplib.resolve_instance(name).distance_matrix()
+
+
+# -- cache keys ----------------------------------------------------------------
+
+
+def _key(**over):
+    base = dict(
+        name="entry",
+        args=(jax.ShapeDtypeStruct((4, 4), jnp.float32),),
+        statics={"k": 8, "n": 4},
+        backend="cpu",
+        jax_version="0.4.37+0.4.36",
+    )
+    base.update(over)
+    return cc.entry_key(
+        base["name"], base["args"], base["statics"],
+        backend=base["backend"], jax_version=base["jax_version"],
+    )
+
+
+def test_key_stable_for_identical_config():
+    assert _key() == _key()
+
+
+@pytest.mark.parametrize(
+    "change",
+    [
+        {"jax_version": "0.4.38+0.4.37"},
+        {"backend": "tpu"},
+        {"args": (jax.ShapeDtypeStruct((4, 4), jnp.float64),)},  # dtype
+        {"args": (jax.ShapeDtypeStruct((8, 4), jnp.float32),)},  # shape
+        {"statics": {"k": 16, "n": 4}},  # static arg value
+        {"statics": {"k": 8, "n": 4, "push_block": 0}},  # static arg set
+        {"name": "entry2"},
+    ],
+    ids=["jax-version", "backend", "dtype", "shape", "static-value",
+         "static-set", "entry-name"],
+)
+def test_key_invalidates_on_any_config_change(change):
+    assert _key(**change) != _key()
+
+
+def test_key_covers_pytree_leaves():
+    fr_a = bb.Frontier(
+        jax.ShapeDtypeStruct((64, 23), jnp.int32),
+        jax.ShapeDtypeStruct((), jnp.int32),
+        jax.ShapeDtypeStruct((), jnp.bool_),
+    )
+    fr_b = fr_a._replace(nodes=jax.ShapeDtypeStruct((128, 23), jnp.int32))
+    assert _key(args=(fr_a,)) != _key(args=(fr_b,))
+
+
+# -- AOT serialized-executable store ------------------------------------------
+
+
+def _toy_jit():
+    from functools import partial
+
+    @partial(jax.jit, static_argnames=("k",))
+    def f(x, k):
+        return x * k + 1.0
+
+    return f
+
+
+def test_aot_store_miss_then_hit(perf_dir):
+    f = _toy_jit()
+    x = jnp.ones((8,), jnp.float32)
+    before = cc.STATS.snapshot()
+    c1 = cc.aot_load_or_compile("toy", f, (x,), {"k": 3})
+    c2 = cc.aot_load_or_compile("toy", f, (x,), {"k": 3})
+    after = cc.STATS.snapshot()
+    assert c1 is not None and c2 is not None
+    assert after["aot_misses"] == before["aot_misses"] + 1
+    assert after["aot_hits"] == before["aot_hits"] + 1
+    # both executables compute the same thing as the jit path
+    np.testing.assert_allclose(np.asarray(c2(x)), np.asarray(f(x, k=3)))
+    # a hit records the recorded compile cost as savings
+    assert after["compile_seconds_saved"] > before["compile_seconds_saved"]
+
+
+def test_aot_store_different_static_misses(perf_dir):
+    f = _toy_jit()
+    x = jnp.ones((8,), jnp.float32)
+    cc.aot_load_or_compile("toy2", f, (x,), {"k": 3})
+    before = cc.STATS.snapshot()
+    c = cc.aot_load_or_compile("toy2", f, (x,), {"k": 5})  # static changed
+    after = cc.STATS.snapshot()
+    assert after["aot_misses"] == before["aot_misses"] + 1
+    np.testing.assert_allclose(np.asarray(c(x)), np.asarray(f(x, k=5)))
+
+
+def test_aot_store_corrupt_file_degrades_to_compile(perf_dir):
+    f = _toy_jit()
+    x = jnp.ones((4,), jnp.float32)
+    cc.aot_load_or_compile("toy3", f, (x,), {"k": 2})
+    key = cc.entry_key("toy3", (x,), {"k": 2})
+    exec_path, _meta, _uns = cc._aot_paths(key)
+    with open(exec_path, "wb") as fh:
+        fh.write(b"garbage")
+    before = cc.STATS.snapshot()
+    c = cc.aot_load_or_compile("toy3", f, (x,), {"k": 2})
+    after = cc.STATS.snapshot()
+    assert c is not None  # degraded to a fresh compile, not a crash
+    assert after["aot_errors"] == before["aot_errors"] + 1
+    np.testing.assert_allclose(np.asarray(c(x)), np.asarray(f(x, k=2)))
+
+
+def test_aot_store_disabled_returns_none(perf_off):
+    f = _toy_jit()
+    assert cc.aot_load_or_compile("toy4", f, (jnp.ones(3),), {"k": 2}) is None
+
+
+# -- host-setup memo -----------------------------------------------------------
+
+
+def test_ascent_memo_roundtrip_bit_identical(perf_dir):
+    d = _d("burma14")
+    pi = np.random.default_rng(0).random(d.shape[0])
+    assert cc.ascent_memo_get(d, "one-tree", 400) is None  # cold
+    cc.ascent_memo_put(d, "one-tree", 400, pi)
+    got = cc.ascent_memo_get(d, "one-tree", 400)
+    np.testing.assert_array_equal(got, pi)  # byte-exact
+    # a different instance / step count misses
+    assert cc.ascent_memo_get(d + 1.0, "one-tree", 400) is None
+    assert cc.ascent_memo_get(d, "one-tree", 200) is None
+
+
+def test_ascent_memo_solve_results_identical(perf_dir):
+    d = _d("burma14")
+    cold = bb.solve(d, capacity=2048, k=32, ils_rounds=0)  # populates memo
+    warm = bb.solve(d, capacity=2048, k=32, ils_rounds=0)  # memo hit
+    assert cc.STATS.snapshot()["ascent_memo_hits"] >= 1
+    assert cold.cost == warm.cost
+    assert cold.root_lower_bound == warm.root_lower_bound
+
+
+# -- buffer donation -----------------------------------------------------------
+
+
+def _warm_frontier(n=10, capacity=512, k=16):
+    d = _d("burma14")[:n, :n]
+    bd = bb._bound_setup(d, "one-tree", node_ascent=0)
+    d64 = np.asarray(d, np.float64)
+    tour = bb.nearest_neighbor_tour(d64)
+    fr = bb.make_root_frontier(
+        n, capacity, np.asarray(bd.min_out, np.float64), pad_rows=k * n
+    )
+    args = (
+        jnp.asarray(bb.tour_cost(d64, tour), jnp.float32),
+        jnp.asarray(tour, jnp.int32),
+        jnp.asarray(d, jnp.float32),
+        bd.min_out, bd.bound_adj, bd.dbar, bd.pi, bd.slack,
+        bd.ascent_step, bd.lam_budget,
+    )
+    return fr, args, bd, n, k
+
+
+def test_expand_loop_output_aliases_donated_input():
+    """The ISSUE 5 donation contract: on CPU the dispatch writes the new
+    frontier into the SAME allocation (pointer identity), and the old
+    handle is consumed."""
+    fr, args, bd, n, k = _warm_frontier()
+    p_in = fr.nodes.unsafe_buffer_pointer()
+    out = bb._expand_loop(
+        fr, *args, k, n, 4, bool(bd.integral), True, 0
+    )
+    assert out[0].nodes.unsafe_buffer_pointer() == p_in
+    assert fr.nodes.is_deleted()
+    # the consumed handle must raise on re-read, not return stale bytes
+    with pytest.raises(RuntimeError):
+        np.asarray(fr.nodes)
+
+
+def test_expand_loop_ref_twin_does_not_donate():
+    fr, args, bd, n, k = _warm_frontier()
+    out = bb._expand_loop_ref(
+        fr, *args, k, n, 2, bool(bd.integral), True, 0
+    )
+    assert not fr.nodes.is_deleted()  # re-dispatchable harness twin
+    out2 = bb._expand_loop_ref(
+        fr, *args, k, n, 2, bool(bd.integral), True, 0
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out[0].nodes), np.asarray(out2[0].nodes)
+    )
+
+
+def test_donating_row_write_aliases():
+    nodes = jnp.zeros((256, 21), jnp.int32)
+    rows = jnp.ones((7, 21), jnp.int32)
+    p_in = nodes.unsafe_buffer_pointer()
+    out = donation.set_rows_donated(nodes, rows)
+    assert out.unsafe_buffer_pointer() == p_in
+    got = np.asarray(out)
+    assert (got[:7] == 1).all() and (got[7:] == 0).all()
+
+
+def test_donating_rank_row_write_aliases():
+    nodes = jnp.zeros((4, 64, 21), jnp.int32)
+    block = jnp.ones((2, 5, 21), jnp.int32)
+    p_in = nodes.unsafe_buffer_pointer()
+    out = donation.set_rank_rows_donated(
+        nodes, jnp.asarray([1, 3], jnp.int32), block
+    )
+    assert out.unsafe_buffer_pointer() == p_in
+    got = np.asarray(out)
+    assert (got[1, :5] == 1).all() and (got[0] == 0).all()
+    assert (got[3, :5] == 1).all() and (got[1, 5:] == 0).all()
+
+
+def test_check_donated_contract():
+    consumed = jnp.ones((8,))
+    jax.jit(lambda x: x + 1, donate_argnums=0)(consumed)
+    contracts.check_donated(consumed, where="test")  # consumed: passes
+    live = jnp.ones((8,))
+    with pytest.raises(contracts.ContractError, match="donation did not"):
+        contracts.check_donated(live, where="test")
+
+
+def test_check_donated_off_level(monkeypatch):
+    monkeypatch.setenv("TSP_CONTRACTS", "off")
+    contracts.check_donated(jnp.ones(3), where="test")  # no-op
+
+
+def test_solve_results_unchanged_by_aot_dispatch(perf_dir):
+    """solve() through the AOT store (cache enabled) must equal the plain
+    jit path bit-for-bit — same optimum, same proof, same node count."""
+    d = _d("burma14")
+    warm = bb.solve(d, capacity=2048, k=32, ils_rounds=0)  # populates
+    again = bb.solve(d, capacity=2048, k=32, ils_rounds=0)  # AOT hits
+    cc._enabled_dir = None
+    try:
+        plain = bb.solve(d, capacity=2048, k=32, ils_rounds=0)
+    finally:
+        cc._enabled_dir = str(perf_dir)
+    assert warm.cost == again.cost == plain.cost
+    assert warm.proven_optimal and again.proven_optimal and plain.proven_optimal
+    assert warm.nodes_expanded == again.nodes_expanded == plain.nodes_expanded
+
+
+# -- serve warmup + host-path trim ---------------------------------------------
+
+
+def test_scheduler_precompile_counts_and_equivalence():
+    from tsp_mpi_reduction_tpu.serve.scheduler import MicroBatchScheduler
+
+    rng = np.random.default_rng(3)
+    xy = rng.random((4, 6, 2)) * 100.0
+    diff = xy[:, :, None, :] - xy[:, None, :, :]
+    dists = np.sqrt(np.sum(diff * diff, axis=-1))
+    with MicroBatchScheduler(max_batch=4, max_wait_ms=1.0) as cold_s:
+        cold = cold_s.submit(dists).wait(timeout=120.0)
+    with MicroBatchScheduler(max_batch=4, max_wait_ms=1.0) as warm_s:
+        warmed = warm_s.precompile([6])
+        assert warmed >= 1
+        assert warm_s.stats()["precompiled_buckets"] == warmed
+        assert warm_s.stats()["precompile_seconds"] >= 0.0
+        warm = warm_s.submit(dists).wait(timeout=120.0)
+    np.testing.assert_array_equal(np.asarray(cold[1]), np.asarray(warm[1]))
+    np.testing.assert_allclose(np.asarray(cold[0]), np.asarray(warm[0]))
+
+
+def test_scheduler_precompile_skips_invalid_sizes():
+    from tsp_mpi_reduction_tpu.serve.scheduler import MicroBatchScheduler
+
+    with MicroBatchScheduler(max_batch=2) as s:
+        assert s.precompile([1, 2, 99]) == 0  # out of [3, MAX_BLOCK_CITIES]
+
+
+def test_canonical_cache_skips_sort_on_identical_and_translated():
+    from tsp_mpi_reduction_tpu.serve import canonical as canon
+
+    cache = canon.CanonicalCache(16)
+    rng = np.random.default_rng(0)
+    # grid-aligned base: jitter invariance is guaranteed only strictly
+    # below step/2 AROUND A GRID POINT (canonical.py module docstring)
+    xy = np.round(rng.random((12, 2)) * 1000.0, 3)
+    a = canon.canonicalize_cached(xy, cache)
+    assert cache.stats()["sorts_saved"] == 0
+    b = canon.canonicalize_cached(xy.copy(), cache)  # identical resubmit
+    c = canon.canonicalize_cached(xy + 77.0, cache)  # translated
+    jit = xy + (rng.random((12, 2)) - 0.5) * 1e-4  # sub-half-step jitter
+    e = canon.canonicalize_cached(jit, cache)
+    assert cache.stats()["sorts_saved"] == 3
+    assert a.key == b.key == c.key == e.key
+    np.testing.assert_array_equal(a.perm, b.perm)
+
+
+def test_canonical_cache_permuted_resubmit_same_key_slow_path():
+    from tsp_mpi_reduction_tpu.serve import canonical as canon
+
+    cache = canon.CanonicalCache(16)
+    rng = np.random.default_rng(1)
+    xy = rng.random((10, 2)) * 1000.0
+    a = canon.canonicalize_cached(xy, cache)
+    perm = rng.permutation(10)
+    b = canon.canonicalize_cached(xy[perm], cache)  # reordered cities
+    assert a.key == b.key  # same canonical instance...
+    assert cache.stats()["sorts_saved"] == 0  # ...but the sort was needed
+    assert cache.stats()["raw_misses"] == 2
+
+
+def test_canonicalize_cached_none_cache_is_canonicalize():
+    from tsp_mpi_reduction_tpu.serve import canonical as canon
+
+    xy = np.random.default_rng(2).random((8, 2)) * 10.0
+    assert (
+        canon.canonicalize_cached(xy, None).key == canon.canonicalize(xy).key
+    )
+    with pytest.raises(ValueError):
+        canon.canonicalize_cached(np.ones((3, 3)), canon.CanonicalCache())
+
+
+def test_service_stats_carry_compile_and_canonical_counters():
+    import io
+
+    from tsp_mpi_reduction_tpu.serve.service import (
+        ServiceConfig,
+        run_jsonl,
+    )
+
+    rng = np.random.default_rng(5)
+    xy = np.round(rng.random((6, 2)) * 100.0, 3)  # grid-aligned (see above)
+    reqs = [json.dumps({"id": f"r{i}", "xy": (xy + i).tolist()}) for i in range(4)]
+    out = io.StringIO()
+    svc = run_jsonl(reqs, out, ServiceConfig(threads=2, max_batch=4))
+    lines = [json.loads(line) for line in out.getvalue().splitlines()]
+    assert [ln["id"] for ln in lines] == ["r0", "r1", "r2", "r3"]
+    stats = json.loads(svc.stats_json())
+    assert stats["cache"]["canonical_sorts_saved"] == 3  # r1-r3 fast-path
+    assert "compile_cache" in stats
+    assert "aot_hits" in stats["compile_cache"]
+
+
+def test_writer_batches_burst_in_order():
+    """A burst of already-resolved responses drains as one write, in
+    input order, with nothing lost (the batched-writer trim)."""
+    import io
+
+    from tsp_mpi_reduction_tpu.serve.service import ServiceConfig, run_jsonl
+
+    rng = np.random.default_rng(6)
+    reqs = [
+        json.dumps({"id": f"b{i}", "xy": (rng.random((5, 2)) * 50).tolist()})
+        for i in range(24)
+    ]
+    out = io.StringIO()
+    run_jsonl(reqs, out, ServiceConfig(threads=8, max_batch=8))
+    got = [json.loads(line)["id"] for line in out.getvalue().splitlines()]
+    assert got == [f"b{i}" for i in range(24)]

@@ -136,6 +136,18 @@ def main() -> int:
     lb_history: list = []
     stalled = False
     child_env = dict(os.environ)
+    # warm-start wiring (PR 5 tentpole): every chunk is a fresh process,
+    # and the relay REQUIRES that — so give them all ONE compile-cache
+    # dir. Chunk 1 populates it (jax persistent cache + AOT executables +
+    # the ascent memo); chunk N+1's startup then drops from full-JIT to
+    # cache-load. Default: a campaign-local dir next to the checkpoint
+    # (self-contained, reaped with it); an explicit TSP_COMPILE_CACHE —
+    # including "off" — always wins.
+    if "TSP_COMPILE_CACHE" not in child_env:
+        child_env["TSP_COMPILE_CACHE"] = os.path.join(
+            os.path.dirname(os.path.abspath(ckpt_real)) or ".",
+            "compile_cache",
+        )
     for chunk in range(1, args.max_chunks + 1):
         line = None
         # a failed attempt is re-run, not fatal: the crash-safe store

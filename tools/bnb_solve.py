@@ -17,6 +17,7 @@ import sys
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
+from tsp_mpi_reduction_tpu.perf import compile_cache as _perf_cache  # noqa: E402
 from tsp_mpi_reduction_tpu.resilience import health as _health  # noqa: E402
 from tsp_mpi_reduction_tpu.utils.backend import select_backend  # noqa: E402
 
@@ -226,6 +227,10 @@ def main() -> int:
                 # absorbed at the spill seam, corrupt checkpoints skipped
                 # in favor of older rotation snapshots, injected faults
                 "health": _health.HEALTH.snapshot(),
+                # compile-once telemetry (perf.compile_cache): AOT store
+                # hits/misses, compile seconds paid vs saved, ascent-memo
+                # hits — the warm-start evidence per chunk process
+                "compile_cache": _perf_cache.stats_dict(),
             }
         )
     )

@@ -264,8 +264,11 @@ def child(args) -> int:
 
         def dispatch(carry):
             # carry = the previous dispatch's incumbent: a true data
-            # dependency, so the M dispatches form one chain
-            _, ic2, _, nodes = bb._expand_loop(
+            # dependency, so the M dispatches form one chain. The _ref
+            # twin (no donation) is REQUIRED here: every dispatch re-pops
+            # the same warm frontier, which the production entry would
+            # consume on the first call
+            _, ic2, _, nodes = bb._expand_loop_ref(
                 fr, carry, inc_tour, d32, bd.min_out, bd.bound_adj,
                 bd.dbar, bd.pi, bd.slack, bd.ascent_step, bd.lam_budget,
                 k, n, args.steps, integral, use_mst, na, kern,

@@ -224,3 +224,14 @@ if [ ! -s BENCH_KROA100_TPU.jsonl ]; then
     grep -q '"chunks"' BENCH_KROA100_TPU.tmp \
         && mv BENCH_KROA100_TPU.tmp BENCH_KROA100_TPU.jsonl
 fi
+
+if [ ! -s BENCH_COMPILE_CACHE_TPU.json ]; then
+    echo "== compile-once: cold vs warm chunk startup + serve first flush =="
+    # PR 5 leg: captures the 50-110 s/component TPU compile savings
+    # (STEP_PROFILE_FINE_TPU.json) as a measured cold/warm ratio. The
+    # parent spawns fresh child processes per measurement; each child
+    # claims the chip in turn (same discipline as the chunked driver).
+    TSP_BENCH=compile TSP_BENCH_COMPILE_OUT=BENCH_COMPILE_CACHE_TPU.json \
+        python bench.py 2> >(tail -3 >&2) | tail -1
+    [ -s BENCH_COMPILE_CACHE_TPU.json ] || rm -f BENCH_COMPILE_CACHE_TPU.json
+fi

@@ -32,7 +32,7 @@ _DEFAULT_TARGETS = [_PKG_DIR, _REPO_ROOT / "tools", _REPO_ROOT / "bench.py"]
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
-        prog="graftlint", description="JAX-hazard lint (rules R1-R5)"
+        prog="graftlint", description="JAX-hazard lint (rules R1-R7)"
     )
     ap.add_argument(
         "paths",

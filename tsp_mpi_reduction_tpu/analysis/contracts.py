@@ -140,6 +140,31 @@ def check_exchange_count(count, capacity: int, *, where: str = ""):
     return count
 
 
+def check_donated(buf, *, where: str = ""):
+    """Post-dispatch contract on a DONATED buffer: the caller's old
+    reference must be consumed (``is_deleted``), i.e. the dispatch really
+    aliased the frontier in place and any later re-read of the stale
+    handle would raise instead of returning old bytes. Metadata-only (no
+    device sync), so it stays on at the default level.
+
+    Failing here means donation silently did NOT happen — the jit lost its
+    ``donate_argnames`` (graftlint R7 guards the static side of this), the
+    buffer was an unexpected alias of another live input, or the backend
+    refused the donation — and the multi-hundred-MB buffer is being copied
+    per dispatch again.
+    """
+    if level() == "off":
+        return buf
+    deleted = getattr(buf, "is_deleted", None)
+    if deleted is not None and not deleted():
+        _fail(
+            where,
+            "donated frontier buffer is still live after the dispatch — "
+            "donation did not alias (per-dispatch full-buffer copy)",
+        )
+    return buf
+
+
 def check_padded_tour(t, *, capacity: Optional[int] = None, where: str = ""):
     """Validate a PaddedTour's structural invariants; returns ``t``.
 

@@ -35,6 +35,17 @@ detectable from the AST:
       and temp-named paths are exempt, as is any scope that ``os.replace``-
       publishes (the temp-file-then-rename pattern); use
       ``resilience.checkpoint.write_json_atomic``/``write_atomic``.
+  R7  jit-frontier-no-donation: a ``jax.jit`` entry point whose parameters
+      include a ``Frontier``/reservoir-sized buffer (a param annotated
+      ``Frontier`` or named ``fr``/``fr_stacked``/``frontier``/``nodes``)
+      without ``donate_argnums``/``donate_argnames`` — every dispatch then
+      COPIES the multi-hundred-MB buffer instead of aliasing it in place
+      (the compile-once PR's donation invariant). Detected forms: jit
+      decorators (bare, called, or ``partial(jax.jit, ...)``) and module
+      assignments ``x = jax.jit(f, ...)`` / ``partial(jax.jit, ...)(f)``
+      where ``f`` is a lambda or a function defined in the same file.
+      A harness that legitimately re-dispatches the SAME buffer opts out
+      with an inline disable (see ``_expand_loop_ref``).
 
 Escape hatches (both are honored, in this order):
 
@@ -73,6 +84,7 @@ RULES = {
     "R4": "jnp call inside a Python for loop",
     "R5": "early return None drops mutated self state",
     "R6": "non-atomic write of a durable artifact",
+    "R7": "jit frontier entry without buffer donation",
 }
 
 #: functions whose WHOLE body R1 treats as a hot loop: the reservoir
@@ -130,6 +142,13 @@ _BUFFER_FACTORIES = frozenset(
 )
 #: calls that make the enclosing scope an atomic-publish pattern (R6)
 _ATOMIC_PUBLISH_CALLS = frozenset({"os.replace", "os.rename"})
+#: parameter names that denote a frontier/reservoir-sized device buffer
+#: (R7); a parameter ANNOTATED ``Frontier`` counts regardless of name
+_FRONTIER_PARAMS = frozenset({"fr", "fr_stacked", "frontier", "nodes"})
+#: jit spellings R7 recognizes as entry-point wrappers
+_JIT_NAMES = ("jax.jit", "jit", "pjit", "jax.pjit")
+#: the kwargs that satisfy R7 (either donation spelling)
+_DONATE_KWARGS = ("donate_argnums", "donate_argnames")
 
 
 @dataclass(frozen=True)
@@ -238,6 +257,52 @@ class _Directives:
         return False
 
 
+def _frontier_param(args: ast.arguments) -> Optional[str]:
+    """The first parameter naming/annotating a frontier-sized buffer, or
+    None. Annotation ``Frontier`` (any dotting) counts regardless of the
+    parameter's name; otherwise the name itself must be one of
+    ``_FRONTIER_PARAMS``."""
+    for a in list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs):
+        if a.arg in _FRONTIER_PARAMS:
+            return a.arg
+        if a.annotation is not None:
+            ann = _dotted(a.annotation) or ""
+            if ann.rsplit(".", 1)[-1] == "Frontier":
+                return a.arg
+    return None
+
+
+def _jit_call_parts(node: ast.AST) -> Tuple[bool, list]:
+    """Is ``node`` a jit wrapper expression, and with which keywords?
+
+    Recognizes the bare name (``@jax.jit``), the configured call
+    (``jax.jit(f, ...)`` / ``@jax.jit(...)``) and the partial form
+    (``partial(jax.jit, ...)``). Returns ``(is_jit, keywords)``.
+    """
+    if _dotted(node) in _JIT_NAMES:
+        return True, []
+    if isinstance(node, ast.Call):
+        name = _dotted(node.func)
+        if name in _JIT_NAMES:
+            return True, node.keywords
+        if name in ("partial", "functools.partial") and node.args:
+            if _dotted(node.args[0]) in _JIT_NAMES:
+                return True, node.keywords
+    return False, []
+
+
+def _frontier_param_funcs(tree: ast.Module) -> Dict[str, str]:
+    """function name -> its frontier param, for every def in the module
+    (lets R7 resolve ``x = jax.jit(f, ...)`` assignments to f's params)."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            p = _frontier_param(node.args)
+            if p is not None:
+                out[node.name] = p
+    return out
+
+
 def _jitted_names(tree: ast.Module) -> Set[str]:
     """Module-level names bound to jitted callables: ``f = jax.jit(...)``
     assignments and ``@jax.jit`` / ``@partial(jax.jit, ...)`` decorated defs."""
@@ -282,6 +347,7 @@ class _FileLinter(ast.NodeVisitor):
         self.hot_paths = hot_paths
         self.directives = _Directives(source)
         self.jitted = _jitted_names(tree)
+        self.frontier_funcs = _frontier_param_funcs(tree)
         self.violations: List[Violation] = []
         # lexical state
         self.scope: List[str] = []
@@ -351,6 +417,7 @@ class _FileLinter(ast.NodeVisitor):
         self.buffer_names = set()
         self.atomic_scope = self._scope_is_atomic(node)
         self._check_r5(node)
+        self._check_r7_def(node)
         for child in node.body:
             self.visit(child)
         self.def_lines.pop()
@@ -390,6 +457,7 @@ class _FileLinter(ast.NodeVisitor):
 
     def visit_Assign(self, node: ast.Assign) -> None:
         self._track_assignment(node.targets, node.value)
+        self._check_r7_assign(node)
         self.generic_visit(node)
 
     def visit_AugAssign(self, node: ast.AugAssign) -> None:
@@ -737,6 +805,58 @@ class _FileLinter(ast.NodeVisitor):
                 "locals computed but never written back — mutated state is "
                 "dropped (the _partition take==0 bug class)",
             )
+
+    # -- R7: jit frontier entry without buffer donation -----------------------
+
+    def _r7_emit(self, node: ast.AST, param: str) -> None:
+        self._emit(
+            node,
+            "R7",
+            f"jit entry takes a frontier-sized buffer (param `{param}`) "
+            "without donate_argnums/donate_argnames — every dispatch "
+            "copies the reservoir-scale buffer instead of aliasing it in "
+            "place; donate the frontier, or disable R7 on a harness that "
+            "intentionally re-dispatches the same buffer",
+        )
+
+    def _check_r7_def(self, node) -> None:
+        if "R7" not in self.rules:
+            return
+        param = _frontier_param(node.args)
+        if param is None:
+            return
+        for dec in node.decorator_list:
+            is_jit, kws = _jit_call_parts(dec)
+            if not is_jit:
+                continue
+            if not any(kw.arg in _DONATE_KWARGS for kw in kws):
+                self._r7_emit(node, param)
+            return  # at most one jit decorator matters
+
+    def _check_r7_assign(self, node: ast.Assign) -> None:
+        if "R7" not in self.rules:
+            return
+        val = node.value
+        if not isinstance(val, ast.Call) or not val.args:
+            return
+        # jax.jit(f, ...) — keywords on the jit call itself
+        is_jit, kws = _jit_call_parts(val.func) if isinstance(
+            val.func, ast.Call
+        ) else (False, [])
+        if _dotted(val.func) in _JIT_NAMES:
+            is_jit, kws = True, val.keywords
+        if not is_jit:
+            return
+        fn_expr = val.args[0]
+        param: Optional[str] = None
+        if isinstance(fn_expr, ast.Lambda):
+            param = _frontier_param(fn_expr.args)
+        elif isinstance(fn_expr, ast.Name):
+            param = self.frontier_funcs.get(fn_expr.id)
+        if param is None:
+            return
+        if not any(kw.arg in _DONATE_KWARGS for kw in kws):
+            self._r7_emit(node, param)
 
     # -- driver --------------------------------------------------------------
 

@@ -401,6 +401,19 @@ def solve_blocks_from_dists(dists, dtype=jnp.float64) -> Tuple[jnp.ndarray, jnp.
     if dists.ndim != 3 or dists.shape[1] != dists.shape[2]:
         raise ValueError(f"expected [B, n, n] distance matrices, got {dists.shape}")
     n = int(dists.shape[1])
+    impl, interpret = _dispatch_config(dtype)
+    return _solve_blocks_impl(dists, n, jnp.dtype(dtype), impl, interpret)
+
+
+def _dispatch_config(dtype) -> Tuple[str, bool]:
+    """The (impl, interpret) pair the block solver will actually dispatch
+    with for ``dtype`` — ONE selection shared by the dispatch path and
+    the precompile warmup, so a warmed executable is by construction the
+    same config the first real flush runs (a drifted duplicate would make
+    precompile report success while every first flush still pays the cold
+    compile). Raises for configs the dispatch path would reject (f64
+    through a Mosaic kernel on real TPU).
+    """
     impl = _effective_impl(dtype)
     # The Pallas kernels lower through Mosaic, which exists only for TPU;
     # every other platform (CPU CI, a hypothetical GPU) runs them in
@@ -419,7 +432,30 @@ def solve_blocks_from_dists(dists, dtype=jnp.float64) -> Tuple[jnp.ndarray, jnp.
             "support); use dtype=float32 (speed mode), or impl='compact'/"
             "'dense' for float64 parity"
         )
-    return _solve_blocks_impl(dists, n, jnp.dtype(dtype), impl, interpret)
+    return impl, interpret
+
+
+def warm_blocks(n: int, batch: int, dtype=jnp.float32) -> float:
+    """AOT-compile the block solver for one ``[batch, n, n]`` bucket
+    WITHOUT executing anything — the serve scheduler's precompile warmup
+    (and the compile bench) call this per configured shape bucket so the
+    first real flush pays a dispatch, not the classic serving recompile
+    storm. Rides the AOT serialized-executable store when the perf cache
+    is enabled (``perf.compile_cache``), else a plain ``lower().compile()``
+    that still populates jax's persistent compilation cache. Returns the
+    wall seconds spent warming."""
+    from ..perf import compile_cache as _perf_cache
+
+    require_x64_if_float64(dtype)
+    dtype = jnp.dtype(dtype)
+    impl, interpret = _dispatch_config(dtype)
+    sd = jax.ShapeDtypeStruct((batch, n, n), dtype)
+    return _perf_cache.warm_entry(
+        f"hk_blocks_n{n}_b{batch}_{dtype.name}_{impl}",
+        _solve_blocks_impl,
+        (sd,),
+        {"n": n, "dtype": dtype, "impl": impl, "interpret": interpret},
+    )
 
 
 def require_x64_if_float64(dtype) -> None:

@@ -238,17 +238,26 @@ def make_rank_alive_min(mesh: jax.sharding.Mesh, integral: bool = False):
     per-spill readback at [R] floats — the full bound columns never
     leave the device.
 
-    Returns a jitted callable ``(bounds [R, F] f32, counts [R] i32,
-    inc scalar f32) -> [R] f32`` where element r is rank r's alive
-    minimum (+inf when the rank holds no open node). Each rank's min is
-    computed shard-locally under ``shard_map`` — no cross-rank traffic.
-    ``integral`` selects the fixed-point alive predicate
-    (``bound <= inc - 1``) matching the engine's ceil-aware pruning.
+    Returns a jitted callable ``(nodes [R, F, cols] i32 packed rows,
+    counts [R] i32, inc scalar f32) -> [R] f32`` where element r is rank
+    r's alive minimum (+inf when the rank holds no open node). The bound
+    column is sliced and bitcast INSIDE the kernel (it is always the
+    second-to-last packed column), so XLA fuses slice + bitcast + masked
+    min into one pass over the resident buffer — the pre-PR-5 form took
+    ``fr.bound``, an eager out-of-jit property slice that materialized a
+    whole [R, F] f32 copy of the column per spill round just to feed it
+    back in. Each rank's min is computed shard-locally under
+    ``shard_map`` — no cross-rank traffic; the buffer is NOT donated (the
+    spill path reads it again right after). ``integral`` selects the
+    fixed-point alive predicate (``bound <= inc - 1``) matching the
+    engine's ceil-aware pruning.
     """
 
-    def body(bounds, counts, inc):
-        b = bounds[0]
-        pos = jnp.arange(b.shape[0], dtype=jnp.int32)
+    def body(nodes, counts, inc):
+        rows = nodes[0]  # [F, cols] packed int32 rows
+        # bound lives at column cols-2 (= n + W + 2) in the packed layout
+        b = jax.lax.bitcast_convert_type(rows[:, -2], jnp.float32)
+        pos = jnp.arange(rows.shape[0], dtype=jnp.int32)
         alive = pos < counts[0]
         if integral:
             alive = alive & (b <= inc - 1.0)

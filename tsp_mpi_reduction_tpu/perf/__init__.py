@@ -1,0 +1,36 @@
+"""Compile-once execution layer (ISSUE 5 tentpole).
+
+The measured cost structure of the engine inverted: STEP_PROFILE_FINE_TPU
+records 49-111 s of XLA compile per component against ~0.3-6.9 ms per
+expansion step, every ``bnb_chunked.py`` chunk is a fresh process that
+re-paid the full JIT, and the serve layer kept only 1.56x of the
+scheduler's raw 3.76x micro-batch speedup because the host path around
+the frozen kernel dominated. This package makes the compile a one-time
+cost:
+
+- :mod:`.compile_cache` — the persistent executable cache: jax's on-disk
+  compilation cache pointed at a repo-managed dir (``TSP_COMPILE_CACHE``),
+  an explicit AOT ``lower().compile()`` + serialized-executable store for
+  the named hot entries, a deterministic host-setup memo (the f64 root
+  ascent), and hit/miss/compile-seconds counters surfaced through the
+  driver/serve stats JSON.
+- :mod:`.donation` — donating in-place writes for the multi-hundred-MB
+  frontier buffer: the spill writeback and sharded keep-slice scatter
+  alias the existing device allocation instead of copying it per call
+  (``_expand_loop``/``_solve_device`` donate their frontier argument at
+  the jit level; these helpers cover the host-side ``.at[].set`` sites).
+"""
+
+from .compile_cache import (  # noqa: F401
+    STATS,
+    aot_load_or_compile,
+    ascent_memo_get,
+    ascent_memo_put,
+    enable,
+    enabled_dir,
+    entry_key,
+    resolve_cache_dir,
+    stats_dict,
+    warm_entry,
+)
+from .donation import set_rows_donated, set_rank_rows_donated  # noqa: F401

@@ -1,0 +1,463 @@
+"""Persistent executable cache: compile each hot entry ONCE per config.
+
+Three layers, all rooted in one repo-managed directory (``TSP_COMPILE_CACHE``;
+``off``/``0`` disables, unset uses ``~/.cache/tsp_mpi_reduction_tpu/jax_cache``):
+
+1. **jax persistent compilation cache** (:func:`enable`): every
+   ``jit``/``lower().compile()`` in the process consults the on-disk cache,
+   so a fresh process (each ``bnb_chunked.py`` chunk, every CLI run) pays a
+   cache *load* instead of the full XLA compile. Unlike the pre-PR
+   ``enable_persistent_cache`` this is enabled on CPU too — XLA:CPU
+   reload works (measured 2.8 s -> 0.2 s on ``_expand_loop``) and the chunk
+   relay is exactly the workload that re-pays it per process.
+
+2. **AOT serialized-executable store** (:func:`aot_load_or_compile`): the
+   named hot entries (``_expand_loop``/``_solve_device``, the Held-Karp
+   vmap buckets) are additionally stored as serialized XLA executables
+   keyed by (entry, static-arg config, arg shapes/dtypes, jax+jaxlib
+   version, backend). A hit skips BOTH the XLA compile and the Python
+   re-trace (``deserialize_and_load`` returns a ready ``Compiled``).
+   XLA:CPU cannot serialize every executable (thunk-runtime symbol
+   references — observed on the real expansion kernel), so the store
+   self-validates at write time: an executable that does not survive a
+   serialize/deserialize round-trip is marked unsupported and the entry
+   permanently falls back to layer 1. Load failures degrade the same way;
+   a stale or corrupt file can never produce a wrong executable because
+   the key covers every compile-relevant input and the XLA loader rejects
+   mismatched payloads loudly.
+
+3. **host-setup memo** (:func:`ascent_memo_get`/``put``): the f64 root
+   Held-Karp ascent is deterministic in (distance matrix, bound mode,
+   steps) and costs hundreds of ms per chunk process; the resulting
+   potentials are memoized next to the executables so a resumed chunk's
+   setup is a file read. Values are bit-identical by construction (same
+   pure-numpy computation, same inputs), so results cannot drift.
+
+All counters live in :data:`STATS` and are surfaced through
+``bnb_solve.py`` / the serve stats JSON (``utils.reporting``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+#: env knob: the cache directory; "off"/"0"/"none" disables every layer
+ENV_VAR = "TSP_COMPILE_CACHE"
+_DISABLED = ("off", "0", "none", "disabled")
+
+
+def default_cache_dir() -> str:
+    return os.path.join(
+        os.path.expanduser("~"), ".cache", "tsp_mpi_reduction_tpu", "jax_cache"
+    )
+
+
+def resolve_cache_dir() -> Optional[str]:
+    """The configured cache dir, or None when caching is disabled."""
+    val = os.environ.get(ENV_VAR)
+    if val is None:
+        return default_cache_dir()
+    val = val.strip()
+    if not val or val.lower() in _DISABLED:
+        return None
+    return val
+
+
+@dataclass
+class CompileCacheStats:
+    """Process-global compile-cache accounting (thread-safe).
+
+    ``aot_*`` counters cover the serialized-executable store;
+    ``compile_seconds_paid`` is wall actually spent in ``lower().compile()``
+    (a jax-persistent-cache hit makes it small without being zero);
+    ``compile_seconds_saved`` is the sum of the recorded compile cost of
+    every AOT store hit — the "would have paid" evidence the tentpole
+    wants measured, not asserted."""
+
+    aot_hits: int = 0
+    aot_misses: int = 0
+    aot_errors: int = 0
+    aot_unsupported: int = 0
+    compile_seconds_paid: float = 0.0
+    compile_seconds_saved: float = 0.0
+    ascent_memo_hits: int = 0
+    ascent_memo_misses: int = 0
+    canonical_sorts_saved: int = 0
+    entries: Dict[str, Dict[str, float]] = field(default_factory=dict)
+    _lock: threading.Lock = field(
+        default_factory=threading.Lock, repr=False, compare=False
+    )
+
+    def record(
+        self, name: str, outcome: str, seconds: float = 0.0
+    ) -> None:
+        with self._lock:
+            e = self.entries.setdefault(
+                name, {"hits": 0, "misses": 0, "errors": 0, "seconds": 0.0}
+            )
+            if outcome == "hit":
+                self.aot_hits += 1
+                e["hits"] += 1
+                self.compile_seconds_saved += seconds
+            elif outcome == "miss":
+                self.aot_misses += 1
+                e["misses"] += 1
+                self.compile_seconds_paid += seconds
+            elif outcome == "unsupported":
+                self.aot_unsupported += 1
+                e["errors"] += 1
+            else:
+                self.aot_errors += 1
+                e["errors"] += 1
+            e["seconds"] += seconds
+
+    def incr(self, counter: str, n: int = 1) -> None:
+        with self._lock:
+            setattr(self, counter, getattr(self, counter) + n)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "dir": enabled_dir(),
+                "aot_hits": self.aot_hits,
+                "aot_misses": self.aot_misses,
+                "aot_errors": self.aot_errors,
+                "aot_unsupported": self.aot_unsupported,
+                "compile_seconds_paid": round(self.compile_seconds_paid, 3),
+                "compile_seconds_saved": round(self.compile_seconds_saved, 3),
+                "ascent_memo_hits": self.ascent_memo_hits,
+                "ascent_memo_misses": self.ascent_memo_misses,
+                "canonical_sorts_saved": self.canonical_sorts_saved,
+                "entries": {
+                    k: dict(v) for k, v in sorted(self.entries.items())
+                },
+            }
+
+
+STATS = CompileCacheStats()
+
+_enable_lock = threading.Lock()
+_enabled_dir: Optional[str] = None
+
+
+def enabled_dir() -> Optional[str]:
+    """The directory :func:`enable` activated, or None (library default:
+    the cache is OPT-IN per process — drivers/benches/serve call enable(),
+    plain library imports never touch the filesystem)."""
+    return _enabled_dir
+
+
+def enable(platform: Optional[str] = None) -> Optional[str]:
+    """Point jax at the persistent compilation cache; idempotent.
+
+    Returns the active cache dir, or None when disabled (``TSP_COMPILE_CACHE``
+    set to off, or the dir cannot be created). ``platform`` is accepted for
+    the legacy ``enable_persistent_cache(platform)`` call shape; the cache
+    is enabled for every platform now — CPU reload was measured 13x faster
+    than the cold compile on ``_expand_loop``, and the chunk relay re-pays
+    the compile per process precisely on CPU fallbacks too.
+    """
+    del platform
+    global _enabled_dir
+    with _enable_lock:
+        if _enabled_dir is not None:
+            return _enabled_dir
+        cache_dir = resolve_cache_dir()
+        if cache_dir is None:
+            return None
+        import jax
+
+        try:
+            os.makedirs(cache_dir, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+        except (OSError, AttributeError, ValueError):
+            return None  # unwritable dir / older jax: run uncached
+        _enabled_dir = cache_dir
+        return cache_dir
+
+
+# -- cache keys ----------------------------------------------------------------
+
+
+def _leaf_sig(x: Any) -> str:
+    shape = tuple(getattr(x, "shape", np.shape(x)))
+    dtype = getattr(x, "dtype", None)
+    return f"{shape}:{np.dtype(dtype) if dtype is not None else type(x).__name__}"
+
+
+def entry_key(
+    name: str,
+    args: Tuple[Any, ...],
+    statics: Dict[str, Any],
+    *,
+    backend: Optional[str] = None,
+    jax_version: Optional[str] = None,
+) -> str:
+    """Content key for one AOT entry: any change to the static-arg config,
+    an arg shape or dtype, the jax/jaxlib version pair, or the backend
+    yields a different key — a stale executable can never be loaded for a
+    config it was not compiled for (tested in tests/test_perf.py).
+
+    ``backend``/``jax_version`` default to the live process values; tests
+    override them to prove invalidation without reinstalling jax.
+    """
+    import jax
+
+    if jax_version is None:
+        import jaxlib
+
+        jax_version = f"{jax.__version__}+{jaxlib.__version__}"
+    if backend is None:
+        backend = jax.default_backend()
+    leaves = jax.tree_util.tree_leaves(args)
+    parts = [
+        "v1",
+        name,
+        jax_version,
+        backend,
+        ";".join(_leaf_sig(x) for x in leaves),
+        ";".join(f"{k}={statics[k]!r}" for k in sorted(statics)),
+    ]
+    h = hashlib.blake2b(digest_size=16)
+    h.update("\x1f".join(parts).encode())
+    return h.hexdigest()
+
+
+# -- AOT serialized-executable store ------------------------------------------
+
+
+def _aot_paths(key: str) -> Tuple[str, str, str]:
+    base = os.path.join(_enabled_dir or "", "aot")
+    return (
+        os.path.join(base, f"{key}.jaxexec"),
+        os.path.join(base, f"{key}.meta.json"),
+        os.path.join(base, f"{key}.unsupported"),
+    )
+
+
+def _abstract(args: Tuple[Any, ...]):
+    """Concrete example args -> ShapeDtypeStructs (pytree-preserving)."""
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(
+            tuple(getattr(x, "shape", np.shape(x))),
+            np.dtype(getattr(x, "dtype", None) or np.asarray(x).dtype),
+        ),
+        args,
+    )
+
+
+def _compile_entry(fn, args, statics, timer_name: Optional[str] = None):
+    """``fn.lower(...).compile()`` with wall accounting. Consults (and
+    populates) the jax persistent compilation cache, so a warm process
+    pays the cache load, not the XLA compile."""
+    t0 = time.perf_counter()
+    compiled = fn.lower(*_abstract(args), **statics).compile()
+    dt = time.perf_counter() - t0
+    if timer_name:
+        from ..utils.profiling import COMPILE_TIMER
+
+        COMPILE_TIMER.add(timer_name, dt)
+    return compiled, dt
+
+
+def aot_load_or_compile(
+    name: str,
+    fn,
+    args: Tuple[Any, ...],
+    statics: Optional[Dict[str, Any]] = None,
+):
+    """Load the serialized executable for ``(name, config)`` or compile,
+    validate, and store it. Returns a ready-to-call ``Compiled`` (dynamic
+    args only — statics are baked in), or None when the cache is disabled
+    or the entry is already marked unserializable on this backend
+    (callers then use the plain jit dispatch, which still rides the
+    layer-1 cache). A first-time serialization failure still returns the
+    freshly compiled executable — only the cross-process store is off.
+
+    The store is advisory, never authoritative: every failure path —
+    unreadable file, deserialize error, backend without executable
+    serialization — degrades to a fresh ``lower().compile()`` and counts
+    itself in :data:`STATS`.
+    """
+    statics = statics or {}
+    if _enabled_dir is None:
+        return None
+    key = entry_key(name, args, statics)
+    exec_path, meta_path, unsupported_path = _aot_paths(key)
+    if os.path.exists(unsupported_path):
+        STATS.record(name, "unsupported")
+        return None
+    from jax.experimental.serialize_executable import (
+        deserialize_and_load,
+        serialize,
+    )
+
+    if os.path.exists(exec_path):
+        try:
+            t0 = time.perf_counter()
+            with open(exec_path, "rb") as f:
+                payload = f.read()
+            with open(meta_path) as f:
+                meta = json.load(f)
+            loaded = deserialize_and_load(
+                payload,
+                _tree_from_meta(meta["in_tree"]),
+                _tree_from_meta(meta["out_tree"]),
+            )
+            saved = float(meta.get("compile_seconds", 0.0))
+            STATS.record(name, "hit", saved)
+            from ..utils.profiling import COMPILE_TIMER
+
+            COMPILE_TIMER.add(f"aot_load.{name}", time.perf_counter() - t0)
+            return loaded
+        except Exception:  # noqa: BLE001 — any load failure = recompile
+            STATS.record(name, "error")
+            # fall through to the compile path; leave the file for a
+            # backend that can read it (the key is backend-specific, so
+            # this branch means THIS backend wrote something it cannot
+            # re-read — overwrite below after re-validation)
+
+    compiled, dt = _compile_entry(fn, args, statics, timer_name=f"compile.{name}")
+    try:
+        payload, in_tree, out_tree = serialize(compiled)
+        # write-time self-validation: XLA:CPU serializes some executables
+        # it cannot deserialize (thunk-runtime symbol refs — observed on
+        # the real expansion kernel); such an entry would make every warm
+        # start pay a failed load. Round-trip NOW and mark unsupported.
+        reloaded = deserialize_and_load(payload, in_tree, out_tree)
+        del reloaded
+        _atomic_write(exec_path, payload)
+        _atomic_write(
+            meta_path,
+            json.dumps(
+                {
+                    "entry": name,
+                    "compile_seconds": dt,
+                    "in_tree": _tree_to_meta(in_tree),
+                    "out_tree": _tree_to_meta(out_tree),
+                }
+            ).encode(),
+        )
+        STATS.record(name, "miss", dt)
+    except Exception:  # noqa: BLE001 — serialization is best-effort
+        STATS.record(name, "unsupported", dt)
+        try:
+            _atomic_write(unsupported_path, b"")
+        except OSError:
+            pass
+        # the in-process executable is still perfectly valid — only the
+        # cross-process store is off for this entry; later processes see
+        # the marker and go straight to the jit path
+    return compiled
+
+
+def warm_entry(
+    name: str,
+    fn,
+    args: Tuple[Any, ...],
+    statics: Optional[Dict[str, Any]] = None,
+) -> float:
+    """Precompile one entry without executing anything (serve warmup /
+    bench legs). Tries the AOT store first; otherwise ``lower().compile()``
+    through the layer-1 cache. Returns the wall seconds spent."""
+    t0 = time.perf_counter()
+    if aot_load_or_compile(name, fn, args, statics) is None:
+        _compile_entry(fn, args, statics or {}, timer_name=f"compile.{name}")
+    return time.perf_counter() - t0
+
+
+def _tree_to_meta(tree) -> str:
+    """PyTreeDefs don't JSON-serialize; pickle them through base64 (the
+    payload next to them is already a pickle — same trust domain)."""
+    import base64
+    import pickle
+
+    return base64.b64encode(pickle.dumps(tree)).decode()
+
+
+def _tree_from_meta(blob: str):
+    import base64
+    import pickle
+
+    return pickle.loads(base64.b64decode(blob.encode()))
+
+
+def _atomic_write(path: str, payload: bytes) -> None:
+    """Crash-safe publish (same discipline as resilience.checkpoint: a
+    writer killed mid-write must not leave a truncated cache entry that
+    poisons every later warm start)."""
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+# -- host-setup memo (deterministic f64 ascent potentials) ---------------------
+
+
+def _ascent_path(key: str) -> str:
+    return os.path.join(_enabled_dir or "", "setup", f"{key}.npy")
+
+
+def ascent_key(d: np.ndarray, bound: str, steps: int) -> str:
+    h = hashlib.blake2b(digest_size=16)
+    a = np.ascontiguousarray(np.asarray(d, np.float64))
+    h.update(f"ascent-v1:{bound}:{steps}:{a.shape}".encode())
+    h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def ascent_memo_get(d: np.ndarray, bound: str, steps: int) -> Optional[np.ndarray]:
+    """Memoized f64 root-ascent potentials, or None. The key covers the
+    exact distance bytes + bound mode + step count, and the stored value
+    is the byte-exact output of the same deterministic computation — a
+    hit cannot change any solver result."""
+    if _enabled_dir is None:
+        return None
+    path = _ascent_path(ascent_key(d, bound, steps))
+    if not os.path.exists(path):
+        STATS.incr("ascent_memo_misses")
+        return None
+    try:
+        pi = np.load(path)
+    except (OSError, ValueError):
+        STATS.incr("ascent_memo_misses")
+        return None
+    if pi.shape != (np.asarray(d).shape[0],):
+        STATS.incr("ascent_memo_misses")  # key collision paranoia: recompute
+        return None
+    STATS.incr("ascent_memo_hits")
+    return np.asarray(pi, np.float64)
+
+
+def ascent_memo_put(d: np.ndarray, bound: str, steps: int, pi: np.ndarray) -> None:
+    if _enabled_dir is None:
+        return
+    import io
+
+    buf = io.BytesIO()
+    np.save(buf, np.asarray(pi, np.float64))
+    try:
+        _atomic_write(_ascent_path(ascent_key(d, bound, steps)), buf.getvalue())
+    except OSError:
+        pass  # memo is an optimization; never fail a solve over it
+
+
+def stats_dict() -> Dict[str, Any]:
+    """The compile-cache counter block for driver/serve stats JSON."""
+    return STATS.snapshot()

@@ -1,0 +1,44 @@
+"""Donating in-place writes for the device frontier buffer.
+
+The physical node buffer is the engine's dominant allocation (capacity +
+k*n padding rows of packed int32 — hundreds of MB at kroA100 scale). The
+host-side spill writebacks used ``nodes.at[:take].set(keep)`` OUTSIDE jit,
+which XLA lowers to copy-the-buffer-then-scatter: a full-buffer
+materialization per spill even though only the kept prefix changes. These
+helpers run the same update under ``jit`` with the buffer DONATED, so XLA
+aliases the output onto the input allocation and writes only the updated
+rows in place (verified by pointer identity in tests/test_perf.py).
+
+Donated inputs are consumed: jax marks the caller's array deleted, so an
+accidental re-read raises instead of silently using stale data —
+``analysis.contracts.check_donated`` turns that invariant into an explicit
+post-dispatch contract at the solver call sites.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def set_rows_donated(nodes: jnp.ndarray, rows: jnp.ndarray) -> jnp.ndarray:
+    """``nodes[:rows.shape[0]] = rows`` with ``nodes`` donated (aliased in
+    place). Row-count shapes are few per solve (each distinct kept-slice
+    height compiles one tiny dynamic_update_slice — the same per-shape
+    cost the previous out-of-jit ``.at[].set`` already paid, minus the
+    whole-buffer copy)."""
+    start = jnp.zeros((), jnp.int32)
+    return jax.lax.dynamic_update_slice(nodes, rows, (start, start))
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def set_rank_rows_donated(
+    nodes: jnp.ndarray, ridx: jnp.ndarray, block: jnp.ndarray
+) -> jnp.ndarray:
+    """Sharded-stack analog: write ``block`` ([len(ridx), t, width]) into
+    rank rows ``ridx`` at column prefix ``[:t]``, donating the stacked
+    buffer. One rectangular scatter, zero whole-buffer copies."""
+    return nodes.at[ridx, : block.shape[1]].set(block)

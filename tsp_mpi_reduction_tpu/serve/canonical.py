@@ -29,7 +29,10 @@ submission's city order (:func:`from_canonical_tour`) and vice versa.
 from __future__ import annotations
 
 import hashlib
+import threading
+from collections import OrderedDict
 from dataclasses import dataclass
+from typing import Optional
 
 import numpy as np
 
@@ -62,14 +65,25 @@ def canonicalize(xy, step: float = DEFAULT_STEP) -> CanonicalInstance:
     response rather than a cache poisoning.
     """
     xy = np.asarray(xy, np.float64)
+    _validate(xy, step)
+    return _canonicalize_validated(xy, step)
+
+
+def _validate(xy: np.ndarray, step: float) -> None:
     if xy.ndim != 2 or xy.shape[-1] != 2 or xy.shape[0] < 1:
         raise ValueError(f"expected [n>=1, 2] coordinates, got shape {xy.shape}")
     if not np.all(np.isfinite(xy)):
         raise ValueError("coordinates must be finite")
     if not step > 0:
         raise ValueError(f"quantization step must be > 0, got {step}")
-    q = np.rint(xy / step).astype(np.int64)
-    q -= q.min(axis=0)  # translation invariance: pin bbox corner to origin
+
+
+def _canonicalize_validated(xy: np.ndarray, step: float) -> CanonicalInstance:
+    return _canonicalize_from_q(int(xy.shape[0]), _quantize_pin(xy, step))
+
+
+def _canonicalize_from_q(n: int, q: np.ndarray) -> CanonicalInstance:
+    """Steps 3-4 (the lexsort + hash) from quantized pinned coordinates."""
     # lexicographic-minimal city order: primary qx, secondary qy (np.lexsort
     # keys are listed least-significant first)
     perm = np.lexsort((q[:, 1], q[:, 0])).astype(np.int64)
@@ -80,8 +94,106 @@ def canonicalize(xy, step: float = DEFAULT_STEP) -> CanonicalInstance:
     inv = np.empty_like(perm)
     inv[perm] = np.arange(perm.shape[0], dtype=np.int64)
     return CanonicalInstance(
-        key=h.hexdigest(), n=int(xy.shape[0]), perm=perm, inv_perm=inv, qxy=qs
+        key=h.hexdigest(), n=n, perm=perm, inv_perm=inv, qxy=qs
     )
+
+
+class CanonicalCache:
+    """Bounded memo of the canonicalization itself: raw-order digest ->
+    :class:`CanonicalInstance`.
+
+    The service's host path used to pay the full ``canonicalize`` —
+    including the O(n log n) lexsort and the inverse-permutation build —
+    on every request, cache HITS included (the solution cache can only be
+    consulted after the key exists). But the dominant resubmission pattern
+    is byte-identical-after-quantization: the same instance re-sent in the
+    same city order, possibly translated and jittered. For those, the
+    quantized origin-pinned (UNSORTED) coordinates are already identical,
+    so a digest of that array is enough to recall the stored perm map and
+    key without re-sorting anything. Translation/jitter invariance is
+    inherited from the quantize+pin steps; a *permuted* resubmission has
+    different raw bytes and pays the one lexsort that genuinely cannot be
+    skipped (the permutation is unknown until sorted), landing on the same
+    final key via the slow path.
+
+    ``sorts_saved`` counts fast-path hits — surfaced in the service cache
+    stats so the trimmed host path is measured, not asserted. Thread-safe;
+    entries are immutable (CanonicalInstance is frozen and its arrays are
+    never mutated by consumers).
+    """
+
+    def __init__(self, capacity: int = 4096) -> None:
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self._entries: "OrderedDict[str, CanonicalInstance]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.sorts_saved = 0
+        self.raw_misses = 0
+
+    @staticmethod
+    def _raw_key(q: np.ndarray) -> str:
+        h = hashlib.blake2b(digest_size=16)
+        h.update(np.int64(q.shape[0]).tobytes())
+        h.update(np.ascontiguousarray(q).tobytes())
+        return h.hexdigest()
+
+    def get(self, q: np.ndarray) -> Optional[CanonicalInstance]:
+        key = self._raw_key(q)
+        with self._lock:
+            ci = self._entries.get(key)
+            if ci is None:
+                self.raw_misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.sorts_saved += 1
+            from ..perf.compile_cache import STATS as _PERF_STATS
+
+            _PERF_STATS.incr("canonical_sorts_saved")
+            return ci
+
+    def put(self, q: np.ndarray, ci: CanonicalInstance) -> None:
+        key = self._raw_key(q)
+        with self._lock:
+            self._entries[key] = ci
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "sorts_saved": self.sorts_saved,
+                "raw_misses": self.raw_misses,
+                "size": len(self._entries),
+            }
+
+
+def _quantize_pin(xy: np.ndarray, step: float) -> np.ndarray:
+    """Steps 1-2 of the canonicalization (quantize + origin-pin), shared
+    by the full path and the sort-skipping fast path."""
+    q = np.rint(xy / step).astype(np.int64)
+    q -= q.min(axis=0)  # translation invariance: pin bbox corner to origin
+    return q
+
+
+def canonicalize_cached(
+    xy, cache: Optional[CanonicalCache], step: float = DEFAULT_STEP
+) -> CanonicalInstance:
+    """:func:`canonicalize` with the lexsort short-circuited through
+    ``cache`` for byte-identical (post-quantization) resubmissions. With
+    ``cache=None`` this IS ``canonicalize``. Validation always runs — a
+    malformed request must fail identically on both paths."""
+    xy = np.asarray(xy, np.float64)
+    _validate(xy, step)
+    if cache is None:
+        return _canonicalize_validated(xy, step)
+    q = _quantize_pin(xy, step)
+    ci = cache.get(q)
+    if ci is None:
+        ci = _canonicalize_from_q(xy.shape[0], q)
+        cache.put(q, ci)
+    return ci
 
 
 def to_canonical_tour(tour: np.ndarray, ci: CanonicalInstance) -> np.ndarray:

@@ -68,6 +68,11 @@ class ServiceConfig:
     #: which an alive-but-wedged worker is abandoned and replaced
     watchdog_interval_s: float = 0.2
     stuck_timeout_s: float = 30.0
+    #: block sizes to precompile at service start (scheduler.precompile):
+    #: every (n, bucket) pair is AOT-warmed before the first request, so
+    #: no request ever pays a cold XLA compile inside its flush. Empty =
+    #: no warmup (the pre-PR-5 behavior)
+    warm_shapes: tuple = ()
     ladder: LadderConfig = field(default_factory=LadderConfig)
 
 
@@ -87,6 +92,12 @@ class SolveService:
             stuck_timeout_s=self.cfg.stuck_timeout_s,
         )
         self.ladder = DeadlineLadder(self.scheduler, self.cfg.ladder)
+        #: canonicalization memo: skips the per-request lexsort for
+        #: byte-identical (post-quantization) resubmissions — the trimmed
+        #: host path around the frozen kernel (see canonical.CanonicalCache)
+        self.canon_cache = canon.CanonicalCache(self.cfg.cache_capacity)
+        if self.cfg.warm_shapes:
+            self.scheduler.precompile(self.cfg.warm_shapes)
         self.responses = 0
         self.errors = 0
         self.deadline_misses = 0
@@ -129,7 +140,9 @@ class SolveService:
                 request.get("deadline_ms", self.cfg.default_deadline_ms)
             )
             with self.timer.phase("serve.canonicalize"):
-                ci = canon.canonicalize(xy, self.cfg.quant_step)
+                ci = canon.canonicalize_cached(
+                    xy, self.canon_cache, self.cfg.quant_step
+                )
         except (KeyError, TypeError, ValueError) as e:
             self._record_error()
             return {"id": req_id, "error": str(e)}
@@ -198,6 +211,13 @@ class SolveService:
         with self._stats_lock:
             responses, errors = self.responses, self.errors
             misses, refreshes = self.deadline_misses, self.refreshes
+        from ..perf import compile_cache as perf_cache
+
+        # the canonicalization memo rides in the cache block: its saved
+        # sorts are the host-path half of the serve cache story
+        cache_stats = dict(self.cache.stats(), **{
+            f"canonical_{k}": v for k, v in self.canon_cache.stats().items()
+        })
         return reporting.service_stats_json(
             responses=responses,
             errors=errors,
@@ -205,10 +225,11 @@ class SolveService:
             refreshes=refreshes,
             rung_failures=dict(self.ladder.rung_failures),
             tier_counts=dict(self.ladder.tier_counts),
-            cache=self.cache.stats(),
+            cache=cache_stats,
             scheduler=self.scheduler.stats(),
             phases_s=dict(self.timer.seconds),
             health=HEALTH.snapshot(),
+            compile_cache=perf_cache.stats_dict(),
         )
 
     def close(self) -> None:
@@ -247,26 +268,54 @@ def run_jsonl(
     # up futures faster than the workers drain them
     window = threading.Semaphore(max(4 * svc.cfg.threads, 16))
 
+    def _resolve(item) -> Dict:
+        fut, ready = item
+        if fut is None:
+            return ready
+        try:
+            return fut.result()
+        except Exception as e:  # noqa: BLE001 — the stream survives
+            return {"id": None, "error": f"internal: {e}"}
+        finally:
+            window.release()
+
     def _writer() -> None:
+        carried = None  # drained-but-unresolved item: next batch's head
         while True:
-            item = pending.get()
+            item = carried if carried is not None else pending.get()
+            carried = None
             if item is None:
                 return
-            fut, ready = item
-            if fut is None:
-                resp = ready
-            else:
+            # batch the JSONL encode: after blocking on the IN-ORDER head
+            # response, opportunistically drain every further item whose
+            # future is ALREADY resolved, so a burst costs ONE write+flush
+            # instead of one syscall pair per response. An unresolved item
+            # ends the batch (it becomes the next head) — batching must
+            # never hold an already-ready response behind a pending
+            # future, and a lone response still flushes immediately, so
+            # interactive pipes keep their per-response latency.
+            stop = False
+            batch = [json.dumps(_resolve(item))]
+            while True:
                 try:
-                    resp = fut.result()
-                except Exception as e:  # noqa: BLE001 — the stream survives
-                    resp = {"id": None, "error": f"internal: {e}"}
-                finally:
-                    window.release()
+                    nxt = pending.get_nowait()
+                except _queue.Empty:
+                    break
+                if nxt is None:
+                    stop = True
+                    break
+                fut, _ready = nxt
+                if fut is not None and not fut.done():
+                    carried = nxt
+                    break
+                batch.append(json.dumps(_resolve(nxt)))
             try:
-                out.write(json.dumps(resp) + "\n")
+                out.write("\n".join(batch) + "\n")
                 out.flush()
             except Exception:  # noqa: BLE001 — broken sink: keep draining
                 pass  # the queue must drain or the reader deadlocks on window
+            if stop:
+                return
 
     writer = threading.Thread(target=_writer, name="serve-writer", daemon=True)
     writer.start()
@@ -314,6 +363,10 @@ def serve_cli(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--cache-size", type=int, default=4096)
     ap.add_argument("--threads", type=int, default=8)
     ap.add_argument("--default-deadline-ms", type=float, default=1000.0)
+    ap.add_argument("--warm", default="",
+                    help="comma-separated block sizes to precompile before "
+                    "serving (e.g. 8,12,16): every (size, bucket) pair is "
+                    "AOT-warmed so no request pays a cold XLA compile")
     ap.add_argument("--stats", action="store_true",
                     help="print the service stats JSON line to stderr on exit")
     args = ap.parse_args(argv)
@@ -323,12 +376,21 @@ def serve_cli(argv: Optional[List[str]] = None) -> int:
     platform = select_backend(args.backend)
     enable_persistent_cache(platform)
 
+    try:
+        warm_shapes = tuple(
+            int(tok) for tok in args.warm.split(",") if tok.strip()
+        )
+    except ValueError:
+        print(f"error: --warm expects comma-separated ints, got {args.warm!r}",
+              file=sys.stderr)
+        return 2
     cfg = ServiceConfig(
         cache_capacity=args.cache_size,
         max_batch=args.max_batch,
         max_wait_ms=args.max_wait_ms,
         threads=args.threads,
         default_deadline_ms=args.default_deadline_ms,
+        warm_shapes=warm_shapes,
     )
     # ExitStack closes BOTH handles deterministically on every path — with
     # the old two-bare-open form, a failing open of the output leaked the

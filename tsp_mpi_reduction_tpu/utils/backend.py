@@ -272,22 +272,12 @@ def cpu_fallback_device():
 
 
 def enable_persistent_cache(platform: str) -> None:
-    """Point jax at the shared on-disk compilation cache.
+    """Point jax at the shared on-disk compilation cache — now a thin
+    shim over ``perf.compile_cache.enable`` (the compile-once subsystem:
+    repo-managed dir via ``TSP_COMPILE_CACHE``, AOT executable store,
+    hit/miss counters). Enabled on CPU too: reload was measured 13x
+    faster than the cold ``_expand_loop`` compile, and the chunk relay
+    re-pays the compile per process precisely on CPU fallbacks."""
+    from ..perf import compile_cache
 
-    Repeat invocations (CLI runs, bench.py, bnb_solve) then skip the slow
-    TPU compiles. Not used on CPU: XLA:CPU AOT reload warns about machine
-    feature mismatches there, and CPU compiles are sub-second anyway.
-    """
-    if platform == "cpu":
-        return
-    import os
-
-    import jax
-
-    cache_dir = os.path.join(
-        os.path.expanduser("~"), ".cache", "tsp_mpi_reduction_tpu", "jax_cache"
-    )
-    os.makedirs(cache_dir, exist_ok=True)
-    jax.config.update("jax_compilation_cache_dir", cache_dir)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+    compile_cache.enable(platform)

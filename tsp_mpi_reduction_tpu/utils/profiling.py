@@ -43,9 +43,21 @@ class PhaseTimer:
         try:
             yield
         finally:
-            dt = time.perf_counter() - t0
-            with self._lock:
-                self.seconds[name] = self.seconds.get(name, 0.0) + dt
+            self.add(name, time.perf_counter() - t0)
+
+    def add(self, name: str, seconds: float) -> None:
+        """Accumulate an externally-measured duration into a phase — for
+        costs measured by another layer (the compile cache times its own
+        ``lower().compile()`` calls) that still belong in one phase table."""
+        with self._lock:
+            self.seconds[name] = self.seconds.get(name, 0.0) + seconds
+
+
+#: process-global timer for compile/AOT-load costs (perf.compile_cache
+#: records into it; drivers fold it into their phase output) — compile
+#: seconds are process-scoped, not per-request, so they get one shared
+#: accumulator rather than riding any single request's PhaseTimer
+COMPILE_TIMER = PhaseTimer()
 
 
 @contextlib.contextmanager

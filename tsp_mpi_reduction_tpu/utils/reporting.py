@@ -84,14 +84,18 @@ def service_stats_json(
     refreshes: int = 0,
     rung_failures: Optional[Dict[str, int]] = None,
     health: Optional[Dict] = None,
+    compile_cache: Optional[Dict] = None,
 ) -> str:
     """Machine-readable serve-layer counters (SpillStats-style): per-tier
     answer counts, cache hit/miss/eviction totals plus the derived hit
     rate, the scheduler's batching evidence (queue-depth high-water
-    mark, batch occupancy, flush causes), and the self-healing ``health``
+    mark, batch occupancy, flush causes), the self-healing ``health``
     block (worker restarts, absorbed retries, fallback restores, injected
-    faults — see ``resilience.health``). One JSON line so log scrapers
-    and the serve bench consume it the same way as ``metrics_json``."""
+    faults — see ``resilience.health``), and the compile-once evidence
+    (``compile_cache``: AOT store hits/misses, compile seconds paid vs
+    saved, canonicalization sorts skipped — see ``perf.compile_cache``).
+    One JSON line so log scrapers and the serve bench consume it the
+    same way as ``metrics_json``."""
     lookups = cache.get("hits", 0) + cache.get("misses", 0)
     payload = {
         "responses": responses,
@@ -104,5 +108,6 @@ def service_stats_json(
         "scheduler": scheduler,
         "phases_s": phases_s or {},
         "health": health or {},
+        "compile_cache": compile_cache or {},
     }
     return json.dumps(payload)
