@@ -14,7 +14,7 @@ PY ?= python
 CXX ?= g++
 CXXFLAGS ?= -O2 -std=c++11
 
-.PHONY: all lint chaos native oracle test test-fast bench bench-serve bench-faults bench-compile run sweep goldens clean
+.PHONY: all lint chaos native oracle test test-fast bench bench-serve bench-faults bench-compile bench-obs run sweep goldens clean
 
 all: lint native oracle chaos
 
@@ -79,6 +79,12 @@ bench-faults:
 # TSP_COMPILE_CACHE dir) -> BENCH_COMPILE_CACHE.json
 bench-compile:
 	TSP_BENCH=compile $(PY) bench.py
+
+# telemetry acceptance bench: full obs (metrics+tracing+sampler) vs
+# TSP_OBS=off B&B wall overhead (<= 2%) + serve span-tree completeness
+# -> BENCH_OBS.json
+bench-obs:
+	TSP_BENCH=obs $(PY) bench.py
 
 # reference `make run` analog: same config, 3-rank-shaped merge tree
 run:

@@ -41,6 +41,13 @@ Modes, selected by ``TSP_BENCH`` (default ``pipeline``):
 - ``faults`` — atomic-checkpoint overhead vs the legacy direct write
   (ISSUE 4); writes ``BENCH_FAULTS.json`` (see :func:`bench_faults`).
 
+- ``obs`` — the telemetry acceptance bench (ISSUE 6): full obs stack
+  (metrics registry + span tracing to JSONL + per-dispatch sampler) vs
+  ``TSP_OBS=off`` B&B wall overhead (acceptance <= 2%), plus serve
+  span-tree completeness (zero orphan spans across a multi-request
+  session with degraded + malformed requests). Writes ``BENCH_OBS.json``
+  (see :func:`bench_obs`).
+
 - ``bnb`` — the north-star metric (BASELINE.json): B&B nodes/sec on a
   TSPLIB instance solved to PROVEN optimality. Default instance: eil51
   (426) — berlin52's Held-Karp root bound equals its optimum, so with the
@@ -805,6 +812,147 @@ def bench_serve() -> int:
     return 0 if ok else 1
 
 
+def bench_obs() -> int:
+    """Telemetry overhead + trace completeness (ISSUE 6 acceptance).
+
+    Two legs, both forced-CPU (host-side instrumentation is what is being
+    priced, not the accelerator):
+
+    1. **B&B A/B** — the same solve config run with full telemetry
+       (metrics + span tracing to a real JSONL sink + the per-dispatch
+       sampler) vs ``TSP_OBS=off``, interleaved reps, median wall each.
+       Acceptance: overhead <= 2%.
+    2. **serve trace** — a multi-request JSONL session (including a
+       malformed line and an impossible deadline) traced to JSONL; every
+       parsed request must reconstruct into a complete span tree (no
+       orphan spans) rooted at ``serve.request``.
+
+    Emits ``BENCH_OBS.json`` (path: ``TSP_BENCH_OBS_OUT``) and prints the
+    same one-line JSON. Exit 1 when either acceptance criterion fails."""
+    import io
+    import statistics
+    import tempfile
+
+    import numpy as np
+
+    from tsp_mpi_reduction_tpu import obs
+    from tsp_mpi_reduction_tpu.models import branch_bound as bb
+    from tsp_mpi_reduction_tpu.obs import tracing
+    from tsp_mpi_reduction_tpu.resilience.checkpoint import write_json_atomic
+    from tsp_mpi_reduction_tpu.utils import tsplib
+
+    reps = int(os.environ.get("TSP_BENCH_OBS_REPS", "7"))
+    spec = os.environ.get("TSP_BENCH_OBS_INSTANCE", "random:12:33")
+    out_path = os.environ.get("TSP_BENCH_OBS_OUT", "BENCH_OBS.json")
+    workdir = tempfile.mkdtemp(prefix="bench_obs_")
+    inst = tsplib.resolve_instance(spec)
+    d = np.rint(inst.distance_matrix() * 10)
+    # host-loop-heavy config: many dispatches -> many sampler rows, the
+    # worst case for per-iteration telemetry cost
+    kw = dict(capacity=256, k=8, inner_steps=1, bound="min-out",
+              mst_prune=False, node_ascent=0, device_loop=False)
+
+    bb.solve(d, **kw)  # warm the XLA compiles out of both arms
+
+    def run_arm(enabled: bool) -> list:
+        obs.set_enabled(enabled)
+        tracing.configure(
+            os.path.join(workdir, "bnb_trace.jsonl") if enabled else None
+        )
+        walls = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            with tracing.span("bnb.solve", instance=inst.name):
+                res = run_arm.res = bb.solve(d, **kw)
+            walls.append(time.perf_counter() - t0)
+            assert res.proven_optimal
+            assert (res.series is not None) == enabled
+        return walls
+
+    try:
+        # interleave arms so host drift hits both equally
+        on_walls, off_walls = [], []
+        for _ in range(2):
+            off_walls += run_arm(False)
+            on_walls += run_arm(True)
+    finally:
+        obs.set_enabled(None)
+        tracing.configure(None)
+    on_ms = statistics.median(on_walls) * 1000.0
+    off_ms = statistics.median(off_walls) * 1000.0
+    overhead_pct = (on_ms / off_ms - 1.0) * 100.0 if off_ms else 0.0
+    bnb_ok = overhead_pct <= 2.0
+
+    # -- serve trace completeness --------------------------------------------
+    from tsp_mpi_reduction_tpu.serve.service import ServiceConfig, run_jsonl
+
+    trace_path = os.path.join(workdir, "serve_trace.jsonl")
+    tracing.configure(trace_path)
+    rng = np.random.default_rng(7)
+    lines = []
+    for i in range(12):
+        req = {"id": f"r{i}", "xy": (rng.random((8, 2)) * 50).tolist()}
+        if i == 5:
+            req["deadline_ms"] = 0.001  # degraded path must trace too
+        lines.append(json.dumps(req))
+    lines.insert(3, "this is not json")
+    out = io.StringIO()
+    try:
+        svc = run_jsonl(lines, out, ServiceConfig(threads=4, max_wait_ms=1.0))
+    finally:
+        tracing.configure(None)
+    responses = len(out.getvalue().strip().splitlines())
+    spans = tracing.read_trace(trace_path)
+    trees = tracing.build_trees(spans)
+    orphans = tracing.orphan_spans(spans)
+    roots = [
+        n for t in trees.values() for n in t["roots"]
+        if n["span"]["name"] == "serve.request"
+    ]
+    incomplete = [n for n in roots if not n["children"]]
+    serve_ok = (
+        responses == 13
+        and len(roots) == 12  # the malformed line never becomes a request
+        and not orphans
+        and not incomplete
+    )
+
+    artifact = {
+        "metric": "obs_overhead",
+        "unit": "pct",
+        "instance": inst.name,
+        "reps_per_arm": len(on_walls),
+        "bnb": {
+            "on_ms": round(on_ms, 3),
+            "off_ms": round(off_ms, 3),
+            "overhead_pct": round(overhead_pct, 2),
+            "series_rows": getattr(run_arm, "res").series["samples_total"],
+            "acceptance_max_pct": 2.0,
+            "ok": bnb_ok,
+        },
+        "serve": {
+            "requests": 12,
+            "responses": responses,
+            "spans": len(spans),
+            "traces": len(trees),
+            "request_roots": len(roots),
+            "orphan_spans": len(orphans),
+            "incomplete_trees": len(incomplete),
+            "stats_health": json.loads(svc.stats_json())["health"],
+            "ok": serve_ok,
+        },
+        "value": round(overhead_pct, 2),
+        "vs_baseline": round(off_ms / on_ms, 4) if on_ms else None,
+        "ok": bnb_ok and serve_ok,
+    }
+    write_json_atomic(out_path, artifact)
+    print(json.dumps(artifact))
+    import shutil
+
+    shutil.rmtree(workdir, ignore_errors=True)
+    return 0 if artifact["ok"] else 1
+
+
 def main() -> int:
     if os.environ.get("TSP_BENCH") == "compile-child":
         # one measured subprocess of the compile bench (selects its own
@@ -823,6 +971,12 @@ def main() -> int:
 
         select_backend("cpu")
         return bench_faults()
+    if os.environ.get("TSP_BENCH") == "obs":
+        # host-side instrumentation pricing — never probes the accelerator
+        from tsp_mpi_reduction_tpu.utils.backend import select_backend
+
+        select_backend("cpu")
+        return bench_obs()
     if (
         os.environ.get("JAX_PLATFORMS", "").strip() == "cpu"
         or os.environ.get("TSP_BENCH_PROBED") == "1"

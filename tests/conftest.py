@@ -25,3 +25,15 @@ GOLDENS = pathlib.Path(__file__).resolve().parent.parent / "goldens"
 @pytest.fixture(scope="session")
 def goldens_dir() -> pathlib.Path:
     return GOLDENS
+
+
+@pytest.fixture(autouse=True)
+def _reset_health_counters():
+    """Per-test snapshot boundary for the registry-backed health counters
+    (ISSUE 6 satellite): the counters are process-global, so without this
+    reset back-to-back tests (and the serve sessions inside them) would
+    see each other's recovery counts."""
+    from tsp_mpi_reduction_tpu.resilience.health import HEALTH
+
+    HEALTH.reset_for_testing()
+    yield

@@ -1,0 +1,641 @@
+"""Unified telemetry layer (ISSUE 6): registry, tracing, time-series.
+
+Covers the metrics registry semantics (labels, kinds, snapshot/delta,
+Prometheus exposition, HTTP endpoint), span-tree reconstruction from a
+real multi-request serve run (no orphan spans, degraded paths included),
+the chaos-suite guarantee that injected faults surface as span events
+with matching trace IDs, the per-dispatch sampler (ring semantics + B&B
+integration), and golden-schema tests for the two stats surfaces
+(``service_stats_json`` and the ``bnb_solve.py`` payload) with counter
+monotonicity.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import io
+import json
+import pathlib
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tsp_mpi_reduction_tpu import obs
+from tsp_mpi_reduction_tpu.obs import metrics, timeseries, tracing
+from tsp_mpi_reduction_tpu.obs.metrics import MetricsRegistry
+from tsp_mpi_reduction_tpu.resilience import faults
+from tsp_mpi_reduction_tpu.resilience.health import HEALTH
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def _obs_clean():
+    """Every test starts with tracing unconfigured and the env override
+    cleared, and leaves them that way."""
+    tracing.configure(None)
+    obs.set_enabled(None)
+    yield
+    tracing.configure(None)
+    obs.set_enabled(None)
+
+
+# -- metrics registry ----------------------------------------------------------
+
+
+def test_counter_labels_and_value():
+    reg = MetricsRegistry()
+    reg.inc("req_total", 1, tier="bnb")
+    reg.inc("req_total", 2, tier="bnb")
+    reg.inc("req_total", 5, tier="greedy")
+    assert reg.value("req_total", tier="bnb") == 3
+    assert reg.value("req_total", tier="greedy") == 5
+    assert reg.value("req_total", tier="nope") == 0
+    assert reg.value("missing_total") == 0
+
+
+def test_counter_rejects_negative_and_kind_flip():
+    reg = MetricsRegistry()
+    reg.inc("a_total")
+    with pytest.raises(ValueError):
+        reg.inc("a_total", -1)
+    with pytest.raises(ValueError):
+        reg.set_gauge("a_total", 5)  # counter name reused as gauge
+    with pytest.raises(ValueError):
+        reg.observe("a_total", 0.1)
+
+
+def test_gauge_sets_not_accumulates():
+    reg = MetricsRegistry()
+    reg.set_gauge("depth", 7)
+    reg.set_gauge("depth", 3)
+    assert reg.value("depth") == 3
+
+
+def test_histogram_buckets_sum_count():
+    reg = MetricsRegistry()
+    reg.declare("lat_seconds", "histogram", buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 5.0):
+        reg.observe("lat_seconds", v)
+    snap = reg.snapshot()
+    h = snap.data["lat_seconds"]["series"][()]
+    assert h["counts"] == [1, 1, 1] and h["count"] == 3
+    assert h["sum"] == pytest.approx(5.55)
+
+
+def test_snapshot_delta_counters_subtract_gauges_current():
+    reg = MetricsRegistry()
+    reg.inc("c_total", 10)
+    reg.set_gauge("g", 1)
+    base = reg.snapshot()
+    reg.inc("c_total", 4)
+    reg.set_gauge("g", 9)
+    d = reg.delta(base)
+    assert d.value("c_total") == 4
+    assert d.value("g") == 9  # gauges report current, not a difference
+
+
+def test_counters_monotone_across_snapshots():
+    reg = MetricsRegistry()
+    reg.inc("m_total", 2, k="a")
+    s1 = reg.snapshot()
+    reg.inc("m_total", 1, k="a")
+    reg.inc("m_total", 7, k="b")
+    s2 = reg.snapshot()
+    for key, v in s1.series("m_total").items():
+        assert s2.series("m_total")[key] >= v
+
+
+def test_prometheus_exposition_format():
+    reg = MetricsRegistry()
+    reg.declare("req_total", "counter", help="requests served")
+    reg.inc("req_total", 3, tier="bnb")
+    reg.declare("lat_seconds", "histogram", buckets=(0.5,))
+    reg.observe("lat_seconds", 0.2)
+    text = metrics.to_prometheus(reg.snapshot())
+    assert "# HELP req_total requests served" in text
+    assert "# TYPE req_total counter" in text
+    assert 'req_total{tier="bnb"} 3' in text
+    assert 'lat_seconds_bucket{le="0.5"} 1' in text
+    assert 'lat_seconds_bucket{le="+Inf"} 1' in text
+    assert "lat_seconds_count 1" in text
+
+
+def test_reset_for_testing_prefix_scoped():
+    reg = MetricsRegistry()
+    reg.inc("health_x_total", 5)
+    reg.inc("other_total", 2)
+    reg.reset_for_testing(prefix="health_")
+    assert reg.value("health_x_total") == 0
+    assert reg.value("other_total") == 2
+
+
+def test_metrics_http_endpoint():
+    metrics.REGISTRY.inc("http_probe_total", 1, who="test")
+    server = metrics.serve_metrics_http(0)
+    try:
+        port = server.server_address[1]
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5
+        ).read().decode()
+        assert 'http_probe_total{who="test"}' in text
+        blob = json.loads(
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics.json", timeout=5
+            ).read()
+        )
+        assert blob["http_probe_total"]["kind"] == "counter"
+    finally:
+        server.shutdown()
+
+
+# -- health view ---------------------------------------------------------------
+
+
+def test_health_snapshot_standard_zeros_and_counts():
+    snap = HEALTH.snapshot()
+    for k in ("worker_restarts", "stuck_restarts", "retries",
+              "fallback_restores"):
+        assert snap[k] == 0  # conftest reset gives every test a boundary
+    HEALTH.incr("retries", 2)
+    HEALTH.incr("custom_event")
+    HEALTH.incr_fault("cache.get")
+    snap = HEALTH.snapshot()
+    assert snap["retries"] == 2 and snap["custom_event"] == 1
+    assert snap["faults_injected"] == {"cache.get": 1}
+    assert HEALTH.get("retries") == 2
+
+
+def test_health_delta_since_isolates_sessions():
+    HEALTH.incr("retries", 3)
+    HEALTH.incr_fault("cache.get")
+    baseline = HEALTH.snapshot()
+    HEALTH.incr("retries", 2)
+    HEALTH.incr_fault("cache.get")
+    HEALTH.incr_fault("ckpt.read")
+    d = HEALTH.delta_since(baseline)
+    assert d["retries"] == 2
+    assert d["faults_injected"] == {"cache.get": 1, "ckpt.read": 1}
+    # the pre-baseline counts never leak into the delta
+    assert d["worker_restarts"] == 0
+
+
+# -- compile-cache entry attribution ------------------------------------------
+
+
+def test_compile_cache_mirrors_entry_labels():
+    from tsp_mpi_reduction_tpu.perf import compile_cache as pc
+
+    reg = metrics.REGISTRY
+    before = reg.value(
+        "compile_cache_outcomes_total", entry="obs_test_entry", outcome="miss"
+    )
+    paid0 = reg.value(
+        "compile_seconds_total", entry="obs_test_entry", kind="paid"
+    )
+    pc.STATS.record("obs_test_entry", "miss", 1.5)
+    assert reg.value(
+        "compile_cache_outcomes_total", entry="obs_test_entry", outcome="miss"
+    ) == before + 1
+    assert reg.value(
+        "compile_seconds_total", entry="obs_test_entry", kind="paid"
+    ) == pytest.approx(paid0 + 1.5)
+
+
+def test_compile_phase_seconds_attributes_per_entry():
+    import jax
+    import jax.numpy as jnp
+
+    from tsp_mpi_reduction_tpu.perf import compile_cache as pc
+
+    fn = jax.jit(lambda x: x + 1)
+    pc._compile_entry(
+        fn, (jnp.zeros(3, jnp.float32),), {},
+        timer_name="compile.obs_phase_entry",
+    )
+    phases = pc.compile_phase_seconds()
+    assert "obs_phase_entry" in phases
+    assert phases["obs_phase_entry"]["compile"] > 0
+
+
+# -- tracing -------------------------------------------------------------------
+
+
+def test_span_disabled_is_null_and_free():
+    with tracing.span("x") as sp:
+        sp.set("a", 1)  # swallowed, not an error
+        sp.event("e")
+    assert tracing.current_context() is None
+
+
+def test_span_tree_nesting_and_events(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    tracing.configure(path)
+    with tracing.span("root", kind="test") as root:
+        with tracing.span("child") as child:
+            tracing.add_event("ping", n=1)
+            assert child.parent_id == root.span_id
+            assert child.trace_id == root.trace_id
+    tracing.configure(None)
+    spans = tracing.read_trace(path)
+    assert [s["name"] for s in spans] == ["child", "root"]  # emit at END
+    trees = tracing.build_trees(spans)
+    (tree,) = trees.values()
+    assert not tree["orphans"] and len(tree["roots"]) == 1
+    child_rec = tree["roots"][0]["children"][0]["span"]
+    assert child_rec["events"][0]["name"] == "ping"
+    assert child_rec["events"][0]["attrs"] == {"n": 1}
+
+
+def test_span_closes_on_exception_with_error_attr(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    tracing.configure(path)
+    with pytest.raises(RuntimeError):
+        with tracing.span("boom"):
+            raise RuntimeError("kapow")
+    tracing.configure(None)
+    (rec,) = tracing.read_trace(path)
+    assert "kapow" in rec["attrs"]["error"]
+
+
+def test_emit_span_parents_cross_thread_context(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    tracing.configure(path)
+    with tracing.span("request") as sp:
+        ctx = tracing.current_context()
+        assert ctx == (sp.trace_id, sp.span_id)
+    fctx = tracing.emit_span("flush", ctx, 0.0, 0.001, {"k": 1})
+    tracing.emit_span("dispatch", fctx, 0.0, 0.0005)
+    tracing.configure(None)
+    spans = tracing.read_trace(path)
+    assert not tracing.orphan_spans(spans)
+    by_name = {s["name"]: s for s in spans}
+    assert by_name["flush"]["parent_id"] == by_name["request"]["span_id"]
+    assert by_name["dispatch"]["parent_id"] == by_name["flush"]["span_id"]
+
+
+def test_orphan_detection():
+    spans = [
+        {"type": "span", "trace_id": "t", "span_id": "a", "parent_id": None,
+         "name": "root", "ts": 0.0, "dur_ms": 1, "attrs": {}, "events": []},
+        {"type": "span", "trace_id": "t", "span_id": "b",
+         "parent_id": "missing", "name": "lost", "ts": 0.0, "dur_ms": 1,
+         "attrs": {}, "events": []},
+    ]
+    assert [s["name"] for s in tracing.orphan_spans(spans)] == ["lost"]
+
+
+# -- per-dispatch sampler ------------------------------------------------------
+
+
+def test_sampler_ring_keeps_newest():
+    s = timeseries.StepSampler(capacity=4)
+    for i in range(10):
+        s.sample(step=i, wall_s=i * 0.1, nodes=1, nodes_per_s=10.0,
+                 frontier=5, incumbent=100.0, lb_floor=90.0)
+    out = s.series()
+    assert out["samples_total"] == 10 and out["samples_dropped"] == 6
+    assert [r[0] for r in out["rows"]] == [6, 7, 8, 9]  # oldest-first tail
+    assert out["columns"][0] == "step"
+
+
+def test_sampler_nonfinite_values_become_null():
+    s = timeseries.StepSampler(capacity=2)
+    s.sample(step=0, wall_s=0.0, nodes=0, nodes_per_s=0.0, frontier=1)
+    (row,) = s.series()["rows"]
+    assert row[7] is None and row[8] is None  # inf incumbent / -inf floor
+    json.dumps(s.series())  # strict-JSON encodable
+
+
+def test_sampler_maybe_respects_tsp_obs_off():
+    obs.set_enabled(False)
+    assert timeseries.StepSampler.maybe() is None
+    obs.set_enabled(True)
+    assert timeseries.StepSampler.maybe() is not None
+
+
+# -- B&B integration -----------------------------------------------------------
+
+
+def _tiny_solve(**over):
+    from tsp_mpi_reduction_tpu.models import branch_bound as bb
+    from tsp_mpi_reduction_tpu.ops.distance import distance_matrix_np
+
+    rng = np.random.default_rng(5)
+    d = distance_matrix_np(rng.random((9, 2)) * 100)
+    kw = dict(capacity=256, k=8, inner_steps=4, bound="min-out",
+              mst_prune=False, node_ascent=0, device_loop=False)
+    kw.update(over)
+    return bb.solve(d, **kw)
+
+
+def test_solve_series_present_and_coherent():
+    reg = metrics.REGISTRY
+    nodes0 = reg.value("bnb_nodes_expanded_total")
+    res = _tiny_solve()
+    assert res.proven_optimal
+    assert res.series is not None
+    cols, rows = res.series["columns"], res.series["rows"]
+    assert cols == list(timeseries.COLUMNS)
+    assert rows, "sampler recorded nothing"
+    steps = [r[cols.index("step")] for r in rows]
+    assert steps == sorted(steps)  # monotone step axis
+    assert sum(r[cols.index("nodes")] for r in rows) <= res.nodes_expanded + 1
+    # final incumbent matches the solve result
+    assert rows[-1][cols.index("incumbent")] == pytest.approx(res.cost)
+    # registry fold happened exactly once with the solve's totals
+    assert reg.value("bnb_nodes_expanded_total") == nodes0 + res.nodes_expanded
+
+
+def test_solve_series_off_under_tsp_obs_off():
+    obs.set_enabled(False)
+    res = _tiny_solve()
+    assert res.proven_optimal and res.series is None
+
+
+# -- golden schemas ------------------------------------------------------------
+
+SERVICE_STATS_SCHEMA = {
+    "responses": int, "errors": int, "deadline_misses": int,
+    "refreshes": int, "rung_failures": dict, "tiers": dict, "cache": dict,
+    "scheduler": dict, "phases_s": dict, "health": dict,
+    "compile_cache": dict, "obs": dict,
+}
+
+BNB_PAYLOAD_SCHEMA = {
+    "instance": str, "dimension": int, "cost": float, "proven_optimal": bool,
+    "nodes_expanded": int, "nodes_per_sec": float, "time_to_best_s": float,
+    "wall_s": float, "setup_s": float, "setup_ascent_s": float,
+    "setup_ils_s": float, "ranks": int, "bound": str, "mst_kernel": str,
+    "push_order": str, "push_block": int, "root_lower_bound": float,
+    "lower_bound": float, "lb_certified": float, "spill_rounds": int,
+    "spill_events": int, "spill_full_merges": int, "spill_bytes_to_host": int,
+    "spill_bytes_to_device": int, "health": dict, "compile_cache": dict,
+    "series": dict, "obs": dict,
+}
+
+
+def _serve_session(n_requests=6, tracing_path=None, deadline_ms=2500.0):
+    from tsp_mpi_reduction_tpu.serve.service import ServiceConfig, run_jsonl
+
+    if tracing_path:
+        tracing.configure(tracing_path)
+    rng = np.random.default_rng(3)
+    lines = [
+        json.dumps({
+            "id": f"r{i}",
+            "xy": (rng.random((8, 2)) * 50).tolist(),
+            "deadline_ms": deadline_ms,
+        })
+        for i in range(n_requests)
+    ]
+    out = io.StringIO()
+    svc = run_jsonl(lines, out, ServiceConfig(threads=4, max_wait_ms=1.0))
+    if tracing_path:
+        tracing.configure(None)
+    return svc, out.getvalue().strip().splitlines()
+
+
+@pytest.mark.serve
+def test_service_stats_json_golden_schema_and_monotonicity():
+    svc, lines = _serve_session(6)
+    assert len(lines) == 6
+    stats = json.loads(svc.stats_json())
+    assert set(stats) == set(SERVICE_STATS_SCHEMA)
+    for key, typ in SERVICE_STATS_SCHEMA.items():
+        assert isinstance(stats[key], typ), (key, type(stats[key]))
+    assert stats["responses"] == 6 and stats["errors"] == 0
+    assert 0.0 <= stats["cache"]["hit_rate"] <= 1.0
+    assert isinstance(stats["obs"]["enabled"], bool)
+    assert isinstance(stats["obs"]["compile_phases_s"], dict)
+    # counter monotonicity: more traffic through the SAME service can
+    # only grow the counting fields
+    stats2 = json.loads(_serve_session(4, deadline_ms=2500.0,
+                                       tracing_path=None)[0].stats_json())
+    del stats2  # independent session; monotonicity is within one service
+    svc2, _ = _serve_session(3)
+    s_a = json.loads(svc2.stats_json())
+    s_b = json.loads(svc2.stats_json())
+    for key in ("responses", "errors", "deadline_misses", "refreshes"):
+        assert s_b[key] >= s_a[key]
+    for tier, count in s_a["tiers"].items():
+        assert s_b["tiers"][tier] >= count
+    for k in ("hits", "misses", "evictions"):
+        assert s_b["cache"][k] >= s_a["cache"][k]
+
+
+def test_bnb_solve_payload_golden_schema():
+    spec = importlib.util.spec_from_file_location(
+        "bnb_solve", REPO / "tools" / "bnb_solve.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    from tsp_mpi_reduction_tpu.utils import tsplib
+
+    inst = tsplib.resolve_instance("random:9:5")
+    from tsp_mpi_reduction_tpu.models import branch_bound as bb
+
+    res = bb.solve(inst.distance_matrix(), capacity=256, k=8, inner_steps=4,
+                   bound="min-out", mst_prune=False, node_ascent=0,
+                   device_loop=False)
+
+    class Args:
+        ranks = 1
+        bound = "min-out"
+        mst_kernel = "prim"
+        push_order = "best-first"
+        push_block = 0
+        balance = "pair"
+
+    payload = mod.result_payload(res, inst, Args())
+    for key, typ in BNB_PAYLOAD_SCHEMA.items():
+        assert key in payload, key
+        assert isinstance(payload[key], typ), (key, type(payload[key]))
+    json.dumps(payload)  # the driver's contract: one encodable JSON line
+    assert payload["series"]["columns"] == list(timeseries.COLUMNS)
+    assert payload["obs"]["enabled"] is True
+    assert payload["balance"] is None  # single-rank runs report no scheme
+
+
+# -- span-tree completeness over a real serve session --------------------------
+
+EXPECTED_REQUEST_STAGES = {"canonicalize", "cache.lookup", "respond"}
+
+
+@pytest.mark.serve
+def test_serve_trace_reconstructs_complete_trees(tmp_path):
+    from tsp_mpi_reduction_tpu.serve.service import ServiceConfig, run_jsonl
+
+    path = str(tmp_path / "serve.jsonl")
+    tracing.configure(path)
+    rng = np.random.default_rng(11)
+    lines = []
+    for i in range(8):
+        req = {"id": f"r{i}", "xy": (rng.random((8, 2)) * 50).tolist(),
+               "deadline_ms": 2500.0}
+        if i == 2:
+            req["deadline_ms"] = 0.001  # degraded greedy path
+        if i == 5:
+            req["xy"] = "garbage"  # malformed: error response, traced too
+        lines.append(json.dumps(req))
+    out = io.StringIO()
+    run_jsonl(lines, out, ServiceConfig(threads=4, max_wait_ms=1.0))
+    tracing.configure(None)
+
+    assert len(out.getvalue().strip().splitlines()) == 8
+    spans = tracing.read_trace(path)
+    assert tracing.orphan_spans(spans) == []  # the acceptance criterion
+    trees = tracing.build_trees(spans)
+    roots = [n for t in trees.values() for n in t["roots"]]
+    assert len(roots) == 8
+    assert all(r["span"]["name"] == "serve.request" for r in roots)
+    ids = {r["span"]["attrs"]["id"] for r in roots}
+    assert ids == {f"r{i}" for i in range(8)}
+    for r in roots:
+        child_names = {c["span"]["name"] for c in r["children"]}
+        rid = r["span"]["attrs"]["id"]
+        if rid == "r5":  # malformed: fails in canonicalize, still closes
+            assert "error" in r["span"]["attrs"]
+            continue
+        assert EXPECTED_REQUEST_STAGES <= child_names, (rid, child_names)
+        assert "ladder.rung" in child_names or "cache.lookup" in child_names
+    # the degraded request answered greedy and its rung span says so
+    r2 = next(r for r in roots if r["span"]["attrs"]["id"] == "r2")
+    rungs = [c["span"] for c in r2["children"]
+             if c["span"]["name"] == "ladder.rung"]
+    assert rungs and rungs[-1]["attrs"]["tier"] == "greedy"
+    # at least one pipeline request shows the full queue-wait -> flush ->
+    # device-dispatch chain under its rung
+    flush_spans = [s for s in spans if s["name"] == "sched.flush"]
+    assert flush_spans, "no flush spans — scheduler path untraced"
+    dispatch_spans = [s for s in spans if s["name"] == "device.dispatch"]
+    assert dispatch_spans
+
+
+@pytest.mark.chaos
+def test_injected_faults_appear_as_span_events_with_matching_trace(tmp_path):
+    from tsp_mpi_reduction_tpu.serve.service import ServiceConfig, run_jsonl
+
+    path = str(tmp_path / "chaos.jsonl")
+    tracing.configure(path)
+    faults.configure("ladder.rung:raise,nth=1,count=2")
+    try:
+        rng = np.random.default_rng(13)
+        lines = [
+            json.dumps({"id": f"r{i}",
+                        "xy": (rng.random((8, 2)) * 50).tolist(),
+                        "deadline_ms": 2500.0})
+            for i in range(4)
+        ]
+        out = io.StringIO()
+        run_jsonl(lines, out, ServiceConfig(threads=2, max_wait_ms=1.0))
+    finally:
+        faults.clear()
+        tracing.configure(None)
+
+    assert len(out.getvalue().strip().splitlines()) == 4
+    spans = tracing.read_trace(path)
+    assert tracing.orphan_spans(spans) == []  # retried/degraded trees close
+    fault_events = [
+        (s, ev)
+        for s in spans
+        for ev in s["events"]
+        if ev["name"] == "fault_injected"
+    ]
+    assert fault_events, "no injected fault surfaced as a span event"
+    roots = {
+        s["trace_id"]: s for s in spans
+        if s["name"] == "serve.request"
+    }
+    for span_rec, ev in fault_events:
+        assert ev["attrs"]["seam"] == "ladder.rung"
+        # the event's span belongs to a request trace — matching trace IDs
+        assert span_rec["trace_id"] in roots
+        assert span_rec["name"] == "ladder.rung"
+    # the retry/degrade left its mark in the health delta too
+    assert HEALTH.snapshot()["faults_injected"]["ladder.rung"] >= 1
+
+
+@pytest.mark.chaos
+def test_worker_seam_fault_event_reaches_the_trace(tmp_path):
+    """The sched.flush seam fires on the WORKER thread (no active span):
+    the injection event must still land in each waiting request's trace,
+    attached to a flush span — including the tombstone flush emitted when
+    the injection kills the worker."""
+    from tsp_mpi_reduction_tpu.serve.service import ServiceConfig, run_jsonl
+
+    path = str(tmp_path / "flushchaos.jsonl")
+    tracing.configure(path)
+    faults.configure("sched.flush:raise,nth=1")
+    try:
+        rng = np.random.default_rng(17)
+        lines = [
+            json.dumps({"id": f"r{i}",
+                        "xy": (rng.random((8, 2)) * 50).tolist(),
+                        "deadline_ms": 4000.0})
+            for i in range(3)
+        ]
+        out = io.StringIO()
+        run_jsonl(lines, out, ServiceConfig(
+            threads=3, max_wait_ms=1.0, watchdog_interval_s=0.05,
+        ))
+    finally:
+        faults.clear()
+        tracing.configure(None)
+
+    assert len(out.getvalue().strip().splitlines()) == 3
+    spans = tracing.read_trace(path)
+    assert tracing.orphan_spans(spans) == []
+    flush_fault_events = [
+        ev
+        for s in spans
+        if s["name"] == "sched.flush"
+        for ev in s["events"]
+        if ev["name"] == "fault_injected"
+    ]
+    assert flush_fault_events, "worker-seam injection vanished from trace"
+    assert all(
+        ev["attrs"]["seam"] == "sched.flush" for ev in flush_fault_events
+    )
+
+
+@pytest.mark.serve
+def test_queue_depth_gauge_drains_to_zero():
+    svc, lines = _serve_session(5)
+    assert len(lines) == 5
+    svc.close()
+    assert metrics.REGISTRY.value("serve_queue_depth_blocks") == 0
+
+
+# -- obs report tool -----------------------------------------------------------
+
+
+def test_obs_report_renders_trace_and_series(tmp_path, capsys):
+    spec = importlib.util.spec_from_file_location(
+        "obs_report", REPO / "tools" / "obs_report.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    trace_path = str(tmp_path / "t.jsonl")
+    tracing.configure(trace_path)
+    with tracing.span("request", id="r0"):
+        with tracing.span("child"):
+            tracing.add_event("fault_injected", seam="cache.get")
+    tracing.configure(None)
+
+    res = _tiny_solve()
+    series_path = tmp_path / "solve.json"
+    series_path.write_text(json.dumps(
+        {"instance": "t9", "series": res.series}
+    ) + "\n")
+
+    rc = mod.main(["--trace", trace_path, "--series", str(series_path)])
+    assert rc == 0
+    text = capsys.readouterr().out
+    assert "request" in text and "child" in text
+    assert "fault_injected" in text
+    assert "0 orphans" in text
+    assert "nodes_per_s" in text and "frontier" in text

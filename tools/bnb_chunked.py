@@ -135,6 +135,10 @@ def main() -> int:
     last = None
     lb_history: list = []
     stalled = False
+    #: per-chunk compile attribution (obs registry entry labels): each
+    #: chunk process reports its OWN compile/aot-load seconds, so the
+    #: summary can show which chunk paid the compile and which warmed
+    compile_by_chunk: list = []
     child_env = dict(os.environ)
     # warm-start wiring (PR 5 tentpole): every chunk is a fresh process,
     # and the relay REQUIRES that — so give them all ONE compile-cache
@@ -214,6 +218,9 @@ def main() -> int:
             return 1
         last = json.loads(line)
         print(line)
+        compile_by_chunk.append(
+            (last.get("obs") or {}).get("compile_phases_s") or {}
+        )
         # a chunk just ran on the backend — later chunks skip the
         # accelerator probe subprocess (each probe is a full jax import
         # plus a chip claim/release cycle: wasted wall and extra exposure
@@ -266,6 +273,16 @@ def main() -> int:
         ),
         "lb_stalled": stalled,
         "total_wall_s": round(time.perf_counter() - t0, 1),
+        # compile cost attributed per chunk process (entry-labeled obs
+        # registry series, satellite of ISSUE 6): chunk 1 pays, the
+        # warm-start chunks show aot_load-only seconds
+        "compile_s_by_chunk": compile_by_chunk,
+        "compile_s_total": {
+            entry: round(sum(c.get(entry, {}).get(ph, 0.0)
+                             for c in compile_by_chunk
+                             for ph in c.get(entry, {})), 4)
+            for entry in {e for c in compile_by_chunk for e in c}
+        },
     }))
     return 0
 

@@ -17,9 +17,96 @@ import sys
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
+from tsp_mpi_reduction_tpu.obs import tracing as _tracing  # noqa: E402
 from tsp_mpi_reduction_tpu.perf import compile_cache as _perf_cache  # noqa: E402
 from tsp_mpi_reduction_tpu.resilience import health as _health  # noqa: E402
+from tsp_mpi_reduction_tpu.utils import reporting as _reporting  # noqa: E402
 from tsp_mpi_reduction_tpu.utils.backend import select_backend  # noqa: E402
+
+
+def result_payload(res, inst, args) -> dict:
+    """The driver's one-line JSON metrics payload — split out of main()
+    so its schema is directly testable (tests/test_obs.py golden-schema
+    suite) and reusable by the obs bench leg. ``args`` needs the solver
+    config attributes (ranks/bound/mst_kernel/push_order/push_block/
+    balance); any argparse.Namespace-alike works."""
+    opt = inst.known_optimum
+    return {
+        "instance": inst.name,
+        "dimension": inst.dimension,
+        "cost": res.cost,
+        "known_optimum": opt,
+        "optimal": (res.cost == opt) if opt is not None else None,
+        "proven_optimal": res.proven_optimal,
+        "nodes_expanded": res.nodes_expanded,
+        "nodes_per_sec": round(res.nodes_per_sec, 1),
+        "time_to_best_s": round(res.time_to_best, 4),
+        "wall_s": round(res.wall_seconds, 3),
+        "setup_s": round(res.setup_seconds, 3),
+        "setup_ascent_s": round(res.ascent_seconds, 3),
+        "setup_ils_s": round(res.ils_seconds, 3),
+        # end-to-end time-to-optimal: bound construction + ILS
+        # incumbent setup + search (root-closure instances do ~all
+        # their work in setup, so wall alone would flatter them)
+        "time_to_proof_s": (
+            round(res.setup_seconds + res.wall_seconds, 3)
+            if res.proven_optimal
+            else None
+        ),
+        "ranks": args.ranks,
+        # per-rank expansion counts (sharded runs): the
+        # load-balance evidence for the multi-rank engine
+        "nodes_per_rank": (
+            [int(x) for x in res.nodes_per_rank]
+            if res.nodes_per_rank is not None
+            else None
+        ),
+        "bound": args.bound,
+        "mst_kernel": args.mst_kernel,
+        "push_order": args.push_order,
+        "push_block": args.push_block,
+        "balance": args.balance if args.ranks > 1 else None,
+        "root_lower_bound": round(res.root_lower_bound, 3),
+        # final certified LB (min over still-open nodes; = cost when
+        # proven) — the honest gap after the search, not the root's.
+        # lb_raw is THIS chunk's un-clamped value; lb_certified (==
+        # lower_bound) is clamped to the running max carried through
+        # the checkpoint, so it is monotone across chunked resumes
+        "lower_bound": round(res.lower_bound, 3),
+        "lb_raw": (
+            round(res.lower_bound_raw, 3)
+            if res.lower_bound_raw > -1e30
+            else None
+        ),
+        "lb_certified": round(res.lower_bound, 3),
+        "gap": (
+            round(res.cost - res.lower_bound, 3)
+            if res.lower_bound > -1e30
+            else None
+        ),
+        # reservoir transfer accounting (SpillStats): proof that
+        # spills move live-prefix bytes only, measured not asserted
+        "spill_rounds": res.spill_rounds,
+        "spill_events": res.spill_events,
+        "spill_full_merges": res.spill_full_merges,
+        "spill_bytes_to_host": res.spill_bytes_to_host,
+        "spill_bytes_to_device": res.spill_bytes_to_device,
+        # self-healing telemetry (resilience.health): retries
+        # absorbed at the spill seam, corrupt checkpoints skipped
+        # in favor of older rotation snapshots, injected faults
+        "health": _health.HEALTH.snapshot(),
+        # compile-once telemetry (perf.compile_cache): AOT store
+        # hits/misses, compile seconds paid vs saved, ascent-memo
+        # hits — the warm-start evidence per chunk process
+        "compile_cache": _perf_cache.stats_dict(),
+        # per-dispatch time series (obs.timeseries): nodes/sec,
+        # frontier occupancy, spill bytes, incumbent/LB-floor
+        # trajectory; null under TSP_OBS=off
+        "series": res.series,
+        # obs layer provenance: trace sink (TSP_TRACE), enabled flag,
+        # per-entry compile-phase attribution from the metrics registry
+        "obs": _reporting.obs_block(trace_path=_tracing.TRACER.path),
+    }
 
 
 def main() -> int:
@@ -117,123 +204,54 @@ def main() -> int:
         return 2
     d = inst.distance_matrix()
 
-    if args.ranks > 1:
-        from tsp_mpi_reduction_tpu.parallel.mesh import make_rank_mesh
+    # one root span per solve when a trace sink is configured
+    # (TSP_TRACE=path.jsonl): chunked campaigns then leave one span per
+    # chunk process in a shared JSONL, renderable by tools/obs_report.py
+    with _tracing.span("bnb.solve", instance=inst.name, ranks=args.ranks):
+        if args.ranks > 1:
+            from tsp_mpi_reduction_tpu.parallel.mesh import make_rank_mesh
 
-        res = bb.solve_sharded(
-            d,
-            make_rank_mesh(args.ranks),
-            capacity_per_rank=args.capacity // args.ranks,
-            k=args.k,
-            inner_steps=args.inner_steps,
-            time_limit_s=args.time_limit,
-            max_iters=args.max_iters,
-            bound=args.bound,
-            node_ascent=args.node_ascent,
-            checkpoint_path=args.checkpoint,
-            checkpoint_every=args.checkpoint_every,
-            resume_from=args.resume,
-            device_loop={"auto": None, "on": True, "off": False}[args.device_loop],
-            reorder_every=args.reorder_every,
-            mst_kernel=args.mst_kernel,
-            balance=args.balance,
-            push_order=args.push_order,
-            push_block=args.push_block,
-        )
-    else:
-        res = bb.solve(
-            d,
-            capacity=args.capacity,
-            k=args.k,
-            inner_steps=args.inner_steps,
-            time_limit_s=args.time_limit,
-            max_iters=args.max_iters,
-            checkpoint_path=args.checkpoint,
-            checkpoint_every=args.checkpoint_every,
-            resume_from=args.resume,
-            bound=args.bound,
-            node_ascent=args.node_ascent,
-            device_loop={"auto": None, "on": True, "off": False}[args.device_loop],
-            reorder_every=args.reorder_every,
-            mst_kernel=args.mst_kernel,
-            push_order=args.push_order,
-            push_block=args.push_block,
-        )
+            res = bb.solve_sharded(
+                d,
+                make_rank_mesh(args.ranks),
+                capacity_per_rank=args.capacity // args.ranks,
+                k=args.k,
+                inner_steps=args.inner_steps,
+                time_limit_s=args.time_limit,
+                max_iters=args.max_iters,
+                bound=args.bound,
+                node_ascent=args.node_ascent,
+                checkpoint_path=args.checkpoint,
+                checkpoint_every=args.checkpoint_every,
+                resume_from=args.resume,
+                device_loop={"auto": None, "on": True, "off": False}[args.device_loop],
+                reorder_every=args.reorder_every,
+                mst_kernel=args.mst_kernel,
+                balance=args.balance,
+                push_order=args.push_order,
+                push_block=args.push_block,
+            )
+        else:
+            res = bb.solve(
+                d,
+                capacity=args.capacity,
+                k=args.k,
+                inner_steps=args.inner_steps,
+                time_limit_s=args.time_limit,
+                max_iters=args.max_iters,
+                checkpoint_path=args.checkpoint,
+                checkpoint_every=args.checkpoint_every,
+                resume_from=args.resume,
+                bound=args.bound,
+                node_ascent=args.node_ascent,
+                device_loop={"auto": None, "on": True, "off": False}[args.device_loop],
+                reorder_every=args.reorder_every,
+                mst_kernel=args.mst_kernel,
+                push_order=args.push_order,
+                push_block=args.push_block,
+            )
 
-    opt = inst.known_optimum
-    print(
-        json.dumps(
-            {
-                "instance": inst.name,
-                "dimension": inst.dimension,
-                "cost": res.cost,
-                "known_optimum": opt,
-                "optimal": (res.cost == opt) if opt is not None else None,
-                "proven_optimal": res.proven_optimal,
-                "nodes_expanded": res.nodes_expanded,
-                "nodes_per_sec": round(res.nodes_per_sec, 1),
-                "time_to_best_s": round(res.time_to_best, 4),
-                "wall_s": round(res.wall_seconds, 3),
-                "setup_s": round(res.setup_seconds, 3),
-                "setup_ascent_s": round(res.ascent_seconds, 3),
-                "setup_ils_s": round(res.ils_seconds, 3),
-                # end-to-end time-to-optimal: bound construction + ILS
-                # incumbent setup + search (root-closure instances do ~all
-                # their work in setup, so wall alone would flatter them)
-                "time_to_proof_s": (
-                    round(res.setup_seconds + res.wall_seconds, 3)
-                    if res.proven_optimal
-                    else None
-                ),
-                "ranks": args.ranks,
-                # per-rank expansion counts (sharded runs): the
-                # load-balance evidence for the multi-rank engine
-                "nodes_per_rank": (
-                    [int(x) for x in res.nodes_per_rank]
-                    if res.nodes_per_rank is not None
-                    else None
-                ),
-                "bound": args.bound,
-                "mst_kernel": args.mst_kernel,
-                "push_order": args.push_order,
-                "push_block": args.push_block,
-                "balance": args.balance if args.ranks > 1 else None,
-                "root_lower_bound": round(res.root_lower_bound, 3),
-                # final certified LB (min over still-open nodes; = cost when
-                # proven) — the honest gap after the search, not the root's.
-                # lb_raw is THIS chunk's un-clamped value; lb_certified (==
-                # lower_bound) is clamped to the running max carried through
-                # the checkpoint, so it is monotone across chunked resumes
-                "lower_bound": round(res.lower_bound, 3),
-                "lb_raw": (
-                    round(res.lower_bound_raw, 3)
-                    if res.lower_bound_raw > -1e30
-                    else None
-                ),
-                "lb_certified": round(res.lower_bound, 3),
-                "gap": (
-                    round(res.cost - res.lower_bound, 3)
-                    if res.lower_bound > -1e30
-                    else None
-                ),
-                # reservoir transfer accounting (SpillStats): proof that
-                # spills move live-prefix bytes only, measured not asserted
-                "spill_rounds": res.spill_rounds,
-                "spill_events": res.spill_events,
-                "spill_full_merges": res.spill_full_merges,
-                "spill_bytes_to_host": res.spill_bytes_to_host,
-                "spill_bytes_to_device": res.spill_bytes_to_device,
-                # self-healing telemetry (resilience.health): retries
-                # absorbed at the spill seam, corrupt checkpoints skipped
-                # in favor of older rotation snapshots, injected faults
-                "health": _health.HEALTH.snapshot(),
-                # compile-once telemetry (perf.compile_cache): AOT store
-                # hits/misses, compile seconds paid vs saved, ascent-memo
-                # hits — the warm-start evidence per chunk process
-                "compile_cache": _perf_cache.stats_dict(),
-            }
-        )
-    )
+    print(json.dumps(result_payload(res, inst, args)))
     return 0
 
 

@@ -1,0 +1,188 @@
+"""Render a human-readable run report from obs telemetry artifacts.
+
+Inputs (any combination):
+
+- ``--trace FILE``    span-trace JSONL (serve ``--trace`` / ``TSP_TRACE``)
+- ``--series FILE``   a ``bnb_solve.py`` JSON line (or a file of lines —
+                      the chunked driver's stdout) whose ``series`` block
+                      carries the per-dispatch sampler rows
+- ``--metrics FILE``  a ``/metrics.json`` snapshot dump
+
+Output is plain text on stdout: per-trace span trees with durations,
+per-column series statistics with a coarse text sparkline, and the top
+metric series. No third-party deps, no file writes.
+
+Usage:
+    python tools/obs_report.py --trace traces/serve.jsonl
+    python tools/obs_report.py --series solve_out.json
+    python tools/obs_report.py --trace t.jsonl --series s.json --limit 5
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from tsp_mpi_reduction_tpu.obs import tracing  # noqa: E402
+
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(values: List[float], width: int = 48) -> str:
+    vals = [v for v in values if v is not None]
+    if not vals:
+        return ""
+    if len(vals) > width:  # decimate to the display width, preserving shape
+        stride = len(vals) / width
+        vals = [vals[int(i * stride)] for i in range(width)]
+    lo, hi = min(vals), max(vals)
+    span = (hi - lo) or 1.0
+    return "".join(
+        _SPARK[min(int((v - lo) / span * (len(_SPARK) - 1)), len(_SPARK) - 1)]
+        for v in vals
+    )
+
+
+def _fmt_attrs(attrs: Dict) -> str:
+    if not attrs:
+        return ""
+    inner = ", ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
+    return f" [{inner}]"
+
+
+def _render_node(node: Dict, depth: int, out: List[str]) -> None:
+    sp = node["span"]
+    out.append(
+        f"{'  ' * depth}{sp['name']}  {sp['dur_ms']:.2f} ms"
+        f"{_fmt_attrs(sp.get('attrs', {}))}"
+    )
+    for ev in sp.get("events", []):
+        out.append(
+            f"{'  ' * (depth + 1)}! event {ev['name']}"
+            f"{_fmt_attrs(ev.get('attrs', {}))}"
+        )
+    for child in node["children"]:
+        _render_node(child, depth + 1, out)
+
+
+def render_trace(path: str, limit: Optional[int] = None) -> str:
+    spans = tracing.read_trace(path)
+    trees = tracing.build_trees(spans)
+    orphans = tracing.orphan_spans(spans)
+    out: List[str] = [
+        f"== trace {path}: {len(spans)} spans, {len(trees)} traces, "
+        f"{len(orphans)} orphans =="
+    ]
+    items = sorted(
+        trees.items(),
+        key=lambda kv: min(
+            (n["span"]["ts"] for n in kv[1]["roots"]), default=0.0
+        ),
+    )
+    shown = items if limit is None else items[:limit]
+    for trace_id, tree in shown:
+        out.append(f"- trace {trace_id}")
+        for root in tree["roots"]:
+            _render_node(root, 1, out)
+        for orphan in tree["orphans"]:
+            out.append(
+                f"  ?? ORPHAN {orphan['name']} "
+                f"(parent {orphan.get('parent_id')} missing)"
+            )
+    if limit is not None and len(items) > limit:
+        out.append(f"... {len(items) - limit} more traces")
+    return "\n".join(out)
+
+
+def render_series(path: str) -> str:
+    out: List[str] = []
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                doc = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            series = doc.get("series") if isinstance(doc, dict) else None
+            if not series or not series.get("rows"):
+                continue
+            cols, rows = series["columns"], series["rows"]
+            name = doc.get("instance", "?")
+            out.append(
+                f"== series {path} [{name}]: {series['samples_total']} "
+                f"samples ({series['samples_dropped']} rolled off) =="
+            )
+            by_col = {c: [r[i] for r in rows] for i, c in enumerate(cols)}
+            for col in cols:
+                vals = [v for v in by_col[col] if v is not None]
+                if not vals:
+                    out.append(f"  {col:>16}: (no finite samples)")
+                    continue
+                out.append(
+                    f"  {col:>16}: min {min(vals):.3f}  "
+                    f"mean {sum(vals) / len(vals):.3f}  max {max(vals):.3f}  "
+                    f"{_sparkline(by_col[col])}"
+                )
+    if not out:
+        out.append(f"== series {path}: no series block found ==")
+    return "\n".join(out)
+
+
+def render_metrics(path: str, top: int = 20) -> str:
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    out: List[str] = [f"== metrics {path}: {len(data)} metrics =="]
+    for name in sorted(data):
+        m = data[name]
+        out.append(f"  {name} ({m['kind']})")
+        for entry in m["series"][:top]:
+            labels = ",".join(f"{k}={v}" for k, v in sorted(entry["labels"].items()))
+            if "hist" in entry:
+                h = entry["hist"]
+                mean = h["sum"] / h["count"] if h["count"] else 0.0
+                val = f"count {h['count']}  mean {mean:.4f}s"
+            else:
+                val = f"{entry['value']:g}"
+            out.append(f"    {{{labels}}} {val}")
+    return "\n".join(out)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="render obs trace/series/metrics artifacts as text"
+    )
+    ap.add_argument("--trace", default=None, help="span JSONL path")
+    ap.add_argument("--series", default=None,
+                    help="bnb_solve JSON (line file ok) with a series block")
+    ap.add_argument("--metrics", default=None, help="/metrics.json dump")
+    ap.add_argument("--limit", type=int, default=None,
+                    help="max traces to render")
+    args = ap.parse_args(argv)
+    if not (args.trace or args.series or args.metrics):
+        ap.error("give at least one of --trace / --series / --metrics")
+    sections = []
+    try:
+        if args.trace:
+            sections.append(render_trace(args.trace, args.limit))
+        if args.series:
+            sections.append(render_series(args.series))
+        if args.metrics:
+            sections.append(render_metrics(args.metrics))
+    except OSError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    try:
+        print("\n\n".join(sections))
+    except BrokenPipeError:
+        return 0  # `| head` closed the pipe: normal CLI behavior
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -85,6 +85,7 @@ RULES = {
     "R5": "early return None drops mutated self state",
     "R6": "non-atomic write of a durable artifact",
     "R7": "jit frontier entry without buffer donation",
+    "R8": "metric/trace recording inside jit-traced code",
 }
 
 #: functions whose WHOLE body R1 treats as a hot loop: the reservoir
@@ -149,6 +150,38 @@ _FRONTIER_PARAMS = frozenset({"fr", "fr_stacked", "frontier", "nodes"})
 _JIT_NAMES = ("jax.jit", "jit", "pjit", "jax.pjit")
 #: the kwargs that satisfy R7 (either donation spelling)
 _DONATE_KWARGS = ("donate_argnums", "donate_argnames")
+#: obs recorder receivers (R8): the dotted ROOT names the telemetry
+#: layer's globals/modules are bound to across the codebase, however
+#: aliased at import
+_OBS_RECORDER_ROOTS = frozenset(
+    {
+        "REGISTRY", "_REGISTRY", "HEALTH", "TRACER", "STATS",
+        "metrics", "tracing", "timeseries", "obs",
+        "_obs_metrics", "_obs_tracing", "_obs_series",
+        "_metrics", "_tracing",
+    }
+)
+#: recorder method names (R8) — only flagged on the roots above, so a
+#: jit body's `fr.nodes.at[i].set(...)` or an estimator's `.observe`
+#: never false-positives
+_OBS_RECORDER_VERBS = frozenset(
+    {
+        "inc", "set_gauge", "observe", "incr", "incr_fault",
+        "add_event", "record", "sample", "span", "emit_span", "event",
+        "fold_bnb_solve", "step_annotation",
+    }
+)
+#: bare-name recorder calls (``from obs.tracing import span``)
+_OBS_BARE_CALLS = frozenset({"span", "add_event", "emit_span"})
+#: higher-order tracers (R8): a function passed here by name is traced
+#: exactly like a jit body
+_TRACED_HOF_NAMES = frozenset(
+    {
+        "shard_map", "jax.lax.scan", "lax.scan", "jax.lax.while_loop",
+        "lax.while_loop", "jax.lax.fori_loop", "lax.fori_loop",
+        "jax.lax.cond", "lax.cond", "jax.vmap", "vmap",
+    }
+)
 
 
 @dataclass(frozen=True)
@@ -331,6 +364,30 @@ def _jitted_names(tree: ast.Module) -> Set[str]:
     return jitted
 
 
+def _traced_callee_names(tree: ast.Module) -> Set[str]:
+    """Function names whose BODIES are jit-traced (R8): defs decorated
+    with jit, defs passed by name to ``jax.jit(f, ...)`` assignments, and
+    defs handed to the traced higher-order operators (shard_map, lax.scan
+    / while_loop / cond / fori_loop, vmap). Name-matched per module — a
+    linter-grade overapproximation."""
+    traced: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if _jit_call_parts(dec)[0]:
+                    traced.add(node.name)
+        elif isinstance(node, ast.Call):
+            name = _dotted(node.func)
+            takes_fn = name in _JIT_NAMES or name in _TRACED_HOF_NAMES
+            if not takes_fn and isinstance(node.func, ast.Call):
+                takes_fn = _jit_call_parts(node.func)[0]
+            if takes_fn:
+                for arg in node.args:
+                    if isinstance(arg, ast.Name):
+                        traced.add(arg.id)
+    return traced
+
+
 class _FileLinter(ast.NodeVisitor):
     def __init__(
         self,
@@ -347,6 +404,7 @@ class _FileLinter(ast.NodeVisitor):
         self.hot_paths = hot_paths
         self.directives = _Directives(source)
         self.jitted = _jitted_names(tree)
+        self.traced_callees = _traced_callee_names(tree)
         self.frontier_funcs = _frontier_param_funcs(tree)
         self.violations: List[Violation] = []
         # lexical state
@@ -355,6 +413,9 @@ class _FileLinter(ast.NodeVisitor):
         self.loop_depth = 0
         self.for_depth = 0
         self.hot = False
+        #: is the current scope's code jit-TRACED (R8)? nested defs
+        #: inside a traced function inherit (lax.scan bodies etc.)
+        self.jit_scope = False
         self.device_names: Set[str] = set()  # assigned from jnp./jax. calls
         self.pulled_names: Set[str] = set()  # assigned from host pulls
         self.tainted: Set[str] = set()  # assigned raw from jitted callees
@@ -402,6 +463,7 @@ class _FileLinter(ast.NodeVisitor):
             self.tainted,
             self.buffer_names,
             self.atomic_scope,
+            self.jit_scope,
         )
         self.scope.append(node.name)
         self.def_lines.append(node.lineno)
@@ -409,6 +471,10 @@ class _FileLinter(ast.NodeVisitor):
             ln in self.directives.hot_lines
             for ln in range(node.lineno, node.body[0].lineno)
         )
+        # R8 scope: a traced def, or any def nested inside one (a scan /
+        # while_loop body defined inline in a jitted function is traced
+        # with it)
+        self.jit_scope = self.jit_scope or node.name in self.traced_callees
         self.loop_depth = 0
         self.for_depth = 0
         self.device_names = set()
@@ -431,6 +497,7 @@ class _FileLinter(ast.NodeVisitor):
             self.tainted,
             self.buffer_names,
             self.atomic_scope,
+            self.jit_scope,
         ) = saved
 
     # -- loops -------------------------------------------------------------
@@ -539,6 +606,7 @@ class _FileLinter(ast.NodeVisitor):
 
     def visit_Call(self, node: ast.Call) -> None:
         name = _dotted(node.func)
+        self._check_r8(node, name)
         in_hot = self.loop_depth > 0 or self.hot
         if in_hot and name in _HOST_PULL_CALLS and node.args:
             if self._is_device_expr(node.args[0]):
@@ -576,6 +644,34 @@ class _FileLinter(ast.NodeVisitor):
                 )
         self._check_r6(node, name)
         self.generic_visit(node)
+
+    # -- R8: metric/trace recording inside jit-traced code -------------------
+
+    def _check_r8(self, node: ast.Call, name: Optional[str]) -> None:
+        """A registry/tracer recording call in a jit-traced body runs at
+        TRACE time, not run time: it records once, as a compile-time
+        constant (silently wrong counts), and if it ever closed over
+        traced values it would force a host callback or recompile. The
+        telemetry layer records around dispatches, never inside them."""
+        if "R8" not in self.rules or not self.jit_scope or name is None:
+            return
+        root, _, _rest = name.partition(".")
+        verb = name.rsplit(".", 1)[-1]
+        hit = (
+            root in _OBS_RECORDER_ROOTS and verb in _OBS_RECORDER_VERBS
+            if "." in name
+            else name in _OBS_BARE_CALLS
+        )
+        if hit:
+            self._emit(
+                node,
+                "R8",
+                f"{name}() records host-side telemetry inside jit-traced "
+                "code — under trace this runs ONCE at compile time "
+                "(recording a constant, not the runtime series) or forces "
+                "a recompile/callback; move the recording to the host "
+                "loop around the dispatch",
+            )
 
     # -- R6: non-atomic write of a durable artifact --------------------------
 

@@ -49,6 +49,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..analysis import contracts as _contracts
+from ..obs import metrics as _obs_metrics
+from ..obs import timeseries as _obs_series
+from ..obs import tracing as _obs_tracing
 from ..perf import compile_cache as _perf_cache
 from ..perf import donation as _donation
 from ..resilience import checkpoint as _ckpt_store
@@ -273,6 +276,11 @@ class BnBResult:
     spill_full_merges: int = 0
     spill_bytes_to_host: int = 0
     spill_bytes_to_device: int = 0
+    #: per-dispatch telemetry time series (obs.timeseries.StepSampler:
+    #: nodes/sec, frontier occupancy, spill bytes each way, incumbent /
+    #: certified-floor trajectory), flushed into the driver JSON; None
+    #: under ``TSP_OBS=off``
+    series: Optional[dict] = None
 
 
 def nearest_neighbor_tour(d: np.ndarray, start: int = 0) -> np.ndarray:
@@ -2048,7 +2056,17 @@ def solve(
     last_ckpt = 0
     last_reorder = 0
     steps_rate = 0.0  # measured in-kernel steps/sec of the last dispatch
+    # per-dispatch telemetry (obs): one ring-buffer row per host-loop
+    # iteration — host-side values the loop already has, zero extra
+    # device traffic; None (one is-None check per iteration) when off
+    sampler = _obs_series.StepSampler.maybe()
+    # profiler step annotation, resolved ONCE (shared nullcontext unless
+    # a device_trace capture is live around this solve)
+    step_ann = _obs_tracing.step_annotation_factory()
     while it < max_iters:
+        t_iter = time.perf_counter()
+        sp_h0, sp_d0 = spill_stats.bytes_to_host, spill_stats.bytes_to_device
+        iter_nodes = 0
         if device_loop:
             # all caps (int32 node-counter overflow, checkpoint cadence,
             # CPU-only clock re-check) live in _dispatch_budget
@@ -2069,20 +2087,24 @@ def solve(
             )
             t_disp = time.perf_counter()
             prev_nodes = fr.nodes if _contracts.level() != "off" else None
-            fr, inc_cost, inc_tour, popped, steps, best_step = _aot_dispatch(
-                "solve_device",
-                _solve_device,
-                (fr, inc_cost, inc_tour) + bound_args
-                + (jnp.asarray(budget, jnp.int32), jnp.asarray(it, jnp.int32)),
-                _sd_statics,
-            )
+            # StepTraceAnnotation segments the profiler timeline by B&B
+            # step while a device_trace capture is active (no-op otherwise)
+            with step_ann(it):
+                fr, inc_cost, inc_tour, popped, steps, best_step = _aot_dispatch(
+                    "solve_device",
+                    _solve_device,
+                    (fr, inc_cost, inc_tour) + bound_args
+                    + (jnp.asarray(budget, jnp.int32), jnp.asarray(it, jnp.int32)),
+                    _sd_statics,
+                )
             if prev_nodes is not None:
                 # the donated frontier must be CONSUMED by the dispatch
                 # (in-place aliasing, not a per-dispatch buffer copy)
                 _contracts.check_donated(prev_nodes, where="solve._solve_device")
             # first readback of the run — everything before this line ran
             # in the relay's fast mode
-            nodes += int(popped)
+            iter_nodes = int(popped)
+            nodes += iter_nodes
             disp_s = time.perf_counter() - t_disp
             if disp_s > 0 and int(steps) > 0:
                 steps_rate = int(steps) / disp_s
@@ -2106,15 +2128,17 @@ def solve(
                 break
         else:
             prev_nodes = fr.nodes if _contracts.level() != "off" else None
-            fr, inc_cost, inc_tour, popped = _aot_dispatch(
-                "expand_loop",
-                _expand_loop,
-                (fr, inc_cost, inc_tour) + bound_args,
-                _el_statics,
-            )
+            with step_ann(it):
+                fr, inc_cost, inc_tour, popped = _aot_dispatch(
+                    "expand_loop",
+                    _expand_loop,
+                    (fr, inc_cost, inc_tour) + bound_args,
+                    _el_statics,
+                )
             if prev_nodes is not None:
                 _contracts.check_donated(prev_nodes, where="solve._expand_loop")
-            nodes += int(popped)
+            iter_nodes = int(popped)
+            nodes += iter_nodes
             it += inner
         cnt = int(fr.count)
         ic = float(inc_cost)
@@ -2155,6 +2179,19 @@ def solve(
             save(checkpoint_path, fr, inc_cost, inc_tour, d=d, bound=bound,
                  reservoir=reservoir, lb_floor=max(lb_floor, root_lb))
             last_ckpt = it
+        if sampler is not None:
+            now = time.perf_counter()
+            sampler.sample(
+                step=it,
+                wall_s=now - t0,
+                nodes=iter_nodes,
+                nodes_per_s=iter_nodes / max(now - t_iter, 1e-9),
+                frontier=cnt,
+                spill_to_host=spill_stats.bytes_to_host - sp_h0,
+                spill_to_device=spill_stats.bytes_to_device - sp_d0,
+                incumbent=ic,
+                lb_floor=max(lb_floor, root_lb),
+            )
         if cnt == 0:
             break
         if time_limit_s is not None and time.perf_counter() - t0 > time_limit_s:
@@ -2175,6 +2212,7 @@ def solve(
         [np.asarray(fr.bound[: int(fr.count)])], reservoir,
         overflow=bool(fr.overflow),
     )
+    _obs_metrics.fold_bnb_solve(nodes, wall, spill_stats)
     return BnBResult(
         cost=float(inc_cost),
         tour=np.asarray(inc_tour),
@@ -2197,6 +2235,7 @@ def solve(
         spill_full_merges=spill_stats.full_merges,
         spill_bytes_to_host=spill_stats.bytes_to_host,
         spill_bytes_to_device=spill_stats.bytes_to_device,
+        series=sampler.series() if sampler is not None else None,
     )
 
 
@@ -2774,7 +2813,11 @@ def solve_sharded(
     last_ckpt = 0
     last_reorder = 0
     rounds_rate = 0.0  # measured in-dispatch rounds/sec of the last dispatch
+    sampler = _obs_series.StepSampler.maybe()
+    step_ann = _obs_tracing.step_annotation_factory()
     while it < max_iters:
+        t_iter = time.perf_counter()
+        sp_h0, sp_d0 = spill_stats.bytes_to_host, spill_stats.bytes_to_device
         if device_loop:
             # one in-dispatch round = inner_steps expansion steps; all
             # caps (psum'd int32 counters, checkpoint cadence, CPU-only
@@ -2797,19 +2840,21 @@ def solve_sharded(
             )
             t_disp = time.perf_counter()
             prev_nodes = fr.nodes if _contracts.level() != "off" else None
-            out = step_loop(tuple(fr), ic, itour, d32, min_out, bound_adj,
-                            bd.dbar, bd.pi, bd.slack, bd.ascent_step,
-                            bd.lam_budget, jnp.asarray(rounds, jnp.int32),
-                            jnp.asarray(it, jnp.int32))
+            with step_ann(it):
+                out = step_loop(tuple(fr), ic, itour, d32, min_out, bound_adj,
+                                bd.dbar, bd.pi, bd.slack, bd.ascent_step,
+                                bd.lam_budget, jnp.asarray(rounds, jnp.int32),
+                                jnp.asarray(it, jnp.int32))
             rounds_done = max(int(out[5][0]), 1)
             disp_s = time.perf_counter() - t_disp
             if disp_s > 0:
                 rounds_rate = rounds_done / disp_s
         else:
             prev_nodes = fr.nodes if _contracts.level() != "off" else None
-            out = step(tuple(fr), ic, itour, d32, min_out, bound_adj, bd.dbar,
-                       bd.pi, bd.slack, bd.ascent_step, bd.lam_budget,
-                       jnp.asarray(it // max(inner_steps, 1), jnp.int32))
+            with step_ann(it):
+                out = step(tuple(fr), ic, itour, d32, min_out, bound_adj, bd.dbar,
+                           bd.pi, bd.slack, bd.ascent_step, bd.lam_budget,
+                           jnp.asarray(it // max(inner_steps, 1), jnp.int32))
             rounds_done = 1
         fr = Frontier(*out[0])
         if prev_nodes is not None:
@@ -2845,6 +2890,20 @@ def solve_sharded(
                  num_ranks=num_ranks, reservoir=_merge_reservoirs(reservoirs),
                  lb_floor=max(lb_floor, root_lb))
             last_ckpt = it
+        if sampler is not None:
+            now = time.perf_counter()
+            step_n = int(step_nodes[0])
+            sampler.sample(
+                step=it,
+                wall_s=now - t0,
+                nodes=step_n,
+                nodes_per_s=step_n / max(now - t_iter, 1e-9),
+                frontier=int(total0),
+                spill_to_host=spill_stats.bytes_to_host - sp_h0,
+                spill_to_device=spill_stats.bytes_to_device - sp_d0,
+                incumbent=best,
+                lb_floor=max(lb_floor, root_lb),
+            )
         if int(total0) == 0:
             break
         if time_limit_s is not None and time.perf_counter() - t0 > time_limit_s:
@@ -2869,6 +2928,7 @@ def solve_sharded(
         merged_res,
         overflow=overflow,
     )
+    _obs_metrics.fold_bnb_solve(nodes, wall, spill_stats)
     return BnBResult(
         cost=float(ic[0]),
         tour=np.asarray(itour)[0],
@@ -2891,6 +2951,7 @@ def solve_sharded(
         spill_full_merges=spill_stats.full_merges,
         spill_bytes_to_host=spill_stats.bytes_to_host,
         spill_bytes_to_device=spill_stats.bytes_to_device,
+        series=sampler.series() if sampler is not None else None,
     )
 
 

@@ -1,0 +1,58 @@
+"""Unified telemetry layer (ISSUE 6): metrics, tracing, time-series.
+
+Three pillars, one package, stdlib-only on the hot paths:
+
+- :mod:`.metrics` — the process-global :data:`~.metrics.REGISTRY` of
+  counters / gauges / histograms with labeled series, snapshot/delta
+  semantics, and two exporters (structured JSON + Prometheus text
+  exposition, optionally over an HTTP endpoint). Every pre-existing
+  signal source — ``resilience.health`` counters, ``SpillStats``,
+  compile-cache hit/miss/seconds, scheduler occupancy/flush counters,
+  ``PhaseTimer`` phases — now records into (or mirrors onto) this one
+  registry, so ``service_stats_json`` and the driver JSON are views over
+  a single source of truth instead of three bespoke builders.
+- :mod:`.tracing` — lightweight span tracing with propagated trace/span
+  IDs. One serve request yields a span tree (request → canonicalize →
+  cache lookup → queue wait → flush → ladder rung → device dispatch →
+  respond) emitted as JSONL; injected faults surface as span events on
+  whatever span was active when the seam fired. ``device_trace`` runs
+  additionally gain ``jax.profiler.StepTraceAnnotation`` per expansion
+  dispatch so TensorBoard/Perfetto timelines segment by B&B step.
+- :mod:`.timeseries` — a ring-buffered per-dispatch sampler in the B&B
+  host loops (nodes/sec, frontier occupancy, spill bytes each way,
+  incumbent/certified-floor trajectory) flushed into the solver result
+  and driver JSON; ``tools/obs_report.py`` renders both artifacts.
+
+Gating: ``TSP_OBS=off`` disables the *optional-overhead* telemetry —
+span tracing, the per-step sampler, profiler step annotations, phase
+mirroring. Plain registry counters stay on regardless: they replace the
+pre-existing health/cache/scheduler counters, which correctness paths
+and stats JSON depend on, and cost one locked dict add per *event*
+(request / flush / dispatch), never per in-kernel step. graftlint rule
+R8 enforces that no recording call ever sits inside jit-traced code.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+#: env knob: "off"/"0"/"false"/"none" disables tracing + sampler +
+#: annotations + phase mirroring (counters stay on; see module docstring)
+ENV_VAR = "TSP_OBS"
+_OFF = ("off", "0", "false", "none", "disabled")
+
+_override: Optional[bool] = None
+
+
+def enabled() -> bool:
+    """Is the optional-overhead telemetry (tracing/sampler/mirroring) on?"""
+    if _override is not None:
+        return _override
+    return os.environ.get(ENV_VAR, "on").strip().lower() not in _OFF
+
+
+def set_enabled(value: Optional[bool]) -> None:
+    """Programmatic override for benches/tests (None = back to the env)."""
+    global _override
+    _override = value

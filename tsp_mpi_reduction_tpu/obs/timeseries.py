@@ -1,0 +1,105 @@
+"""Ring-buffered per-dispatch sampler for the B&B host loops.
+
+One row per host-loop iteration (i.e. per device dispatch — NEVER per
+in-kernel step, which would mean a readback per step, the exact transfer
+pattern the device-resident engine exists to avoid): expansion progress,
+nodes/sec, frontier occupancy, spill bytes each way, and the incumbent /
+certified-floor trajectory. The ring keeps the newest ``capacity`` rows
+(``samples_dropped`` records how many older ones rolled off), so a
+multi-hour proof campaign costs bounded memory.
+
+The series flushes into ``BnBResult.series`` → ``bnb_solve.py`` /
+``bnb_chunked.py`` JSON, and ``tools/obs_report.py`` renders it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from . import enabled as _obs_enabled
+
+#: row layout, in order — kept in the JSON artifact so readers can zip
+COLUMNS = (
+    "step",            # cumulative expansion-step counter (solver `it`)
+    "wall_s",          # seconds since the search loop started
+    "nodes",           # nodes expanded by THIS dispatch
+    "nodes_per_s",     # this dispatch's expansion rate
+    "frontier",        # live frontier rows after the dispatch (+ spill)
+    "spill_to_host",   # bytes spilled host-ward by this iteration
+    "spill_to_device", # bytes refilled device-ward by this iteration
+    "incumbent",       # best tour cost so far
+    "lb_floor",        # certified lower-bound floor (root/resume clamp)
+)
+
+
+class StepSampler:
+    def __init__(self, capacity: int = 512):
+        if capacity < 1:
+            raise ValueError(f"sampler capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self._rows: List[List[Any]] = []
+        self._total = 0
+
+    @classmethod
+    def maybe(cls, capacity: int = 512) -> Optional["StepSampler"]:
+        """A sampler when obs is enabled, else None (the solver guards
+        each sample call on the returned handle, so ``TSP_OBS=off`` pays
+        one `is None` check per dispatch)."""
+        return cls(capacity) if _obs_enabled() else None
+
+    def sample(
+        self,
+        *,
+        step: int,
+        wall_s: float,
+        nodes: int,
+        nodes_per_s: float,
+        frontier: int,
+        spill_to_host: int = 0,
+        spill_to_device: int = 0,
+        incumbent: float = float("inf"),
+        lb_floor: float = float("-inf"),
+    ) -> None:
+        # hot path (once per host-loop iteration): store raw values only;
+        # all rounding/JSON-sanitizing happens once, in series()
+        row = (
+            step, wall_s, nodes, nodes_per_s, frontier,
+            spill_to_host, spill_to_device, incumbent, lb_floor,
+        )
+        if len(self._rows) < self.capacity:
+            self._rows.append(row)
+        else:
+            self._rows[self._total % self.capacity] = row
+        self._total += 1
+
+    def __len__(self) -> int:
+        return len(self._rows)
+
+    def series(self) -> Dict[str, Any]:
+        """JSON-ready artifact: rows oldest-first plus ring accounting."""
+        if self._total <= self.capacity:
+            raw = list(self._rows)
+        else:
+            pivot = self._total % self.capacity
+            raw = self._rows[pivot:] + self._rows[:pivot]
+
+        def _finite(x: float) -> Optional[float]:
+            # ±inf (no incumbent yet / no certified floor) would emit
+            # non-strict JSON (`Infinity`); null is the honest encoding
+            x = float(x)
+            return x if -1e308 < x < 1e308 else None
+
+        rows = [
+            [
+                int(r[0]), round(float(r[1]), 6), int(r[2]),
+                round(float(r[3]), 3), int(r[4]), int(r[5]), int(r[6]),
+                _finite(r[7]), _finite(r[8]),
+            ]
+            for r in raw
+        ]
+        return {
+            "columns": list(COLUMNS),
+            "rows": rows,
+            "samples_total": self._total,
+            "samples_dropped": max(self._total - self.capacity, 0),
+        }

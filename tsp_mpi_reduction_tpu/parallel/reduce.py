@@ -45,6 +45,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..analysis import contracts
+from ..obs.metrics import REGISTRY as _REGISTRY
 from ..ops.merge import PaddedTour, merge_tours
 from ..utils.backend import shard_map
 from .mesh import RANK_AXIS
@@ -265,6 +266,14 @@ def make_rank_alive_min(mesh: jax.sharding.Mesh, integral: bool = False):
             alive = alive & (b < inc)
         return jnp.min(jnp.where(alive, b, jnp.inf))[None]
 
+    # counted HERE, at build time on the host — never inside ``body``,
+    # which is jit-traced (graftlint R8): each (mesh, integral) config
+    # should build its collective once per process; a growing series is
+    # recompile evidence the obs registry makes scrapable
+    _REGISTRY.inc(
+        "collectives_built_total", kind="rank_alive_min",
+        ranks=mesh.devices.size, integral=integral,
+    )
     return jax.jit(
         shard_map(
             body,

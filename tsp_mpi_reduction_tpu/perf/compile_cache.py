@@ -49,6 +49,8 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
+from ..obs.metrics import REGISTRY as _REGISTRY
+
 #: env knob: the cache directory; "off"/"0"/"none" disables every layer
 ENV_VAR = "TSP_COMPILE_CACHE"
 _DISABLED = ("off", "0", "none", "disabled")
@@ -99,6 +101,22 @@ class CompileCacheStats:
     def record(
         self, name: str, outcome: str, seconds: float = 0.0
     ) -> None:
+        # mirror onto the obs registry (ENTRY-labeled, so chunked
+        # campaigns attribute compile cost per entry per chunk process —
+        # the stats-JSON compile block reads back from here)
+        _REGISTRY.inc(
+            "compile_cache_outcomes_total", 1, entry=name, outcome=outcome
+        )
+        if outcome == "hit":
+            _REGISTRY.inc(
+                "compile_seconds_total", max(seconds, 0.0),
+                entry=name, kind="saved",
+            )
+        elif outcome == "miss":
+            _REGISTRY.inc(
+                "compile_seconds_total", max(seconds, 0.0),
+                entry=name, kind="paid",
+            )
         with self._lock:
             e = self.entries.setdefault(
                 name, {"hits": 0, "misses": 0, "errors": 0, "seconds": 0.0}
@@ -120,6 +138,7 @@ class CompileCacheStats:
             e["seconds"] += seconds
 
     def incr(self, counter: str, n: int = 1) -> None:
+        _REGISTRY.inc("compile_cache_events_total", n, event=counter)
         with self._lock:
             setattr(self, counter, getattr(self, counter) + n)
 
@@ -269,6 +288,13 @@ def _compile_entry(fn, args, statics, timer_name: Optional[str] = None):
         from ..utils.profiling import COMPILE_TIMER
 
         COMPILE_TIMER.add(timer_name, dt)
+        # entry-labeled registry series (satellite: COMPILE_TIMER's flat
+        # phase dict folded compile cost into whichever consumer read it
+        # first; the labeled counter gives every consumer delta reads)
+        kind, _, entry = timer_name.partition(".")
+        _REGISTRY.inc(
+            "compile_phase_seconds_total", dt, entry=entry or kind, phase=kind
+        )
     return compiled, dt
 
 
@@ -320,7 +346,12 @@ def aot_load_or_compile(
             STATS.record(name, "hit", saved)
             from ..utils.profiling import COMPILE_TIMER
 
-            COMPILE_TIMER.add(f"aot_load.{name}", time.perf_counter() - t0)
+            load_s = time.perf_counter() - t0
+            COMPILE_TIMER.add(f"aot_load.{name}", load_s)
+            _REGISTRY.inc(
+                "compile_phase_seconds_total", load_s,
+                entry=name, phase="aot_load",
+            )
             return loaded
         except Exception:  # noqa: BLE001 — any load failure = recompile
             STATS.record(name, "error")
@@ -461,3 +492,17 @@ def ascent_memo_put(d: np.ndarray, bound: str, steps: int, pi: np.ndarray) -> No
 def stats_dict() -> Dict[str, Any]:
     """The compile-cache counter block for driver/serve stats JSON."""
     return STATS.snapshot()
+
+
+def compile_phase_seconds() -> Dict[str, Dict[str, float]]:
+    """Per-entry compile/AOT-load seconds, read from the obs registry's
+    ``compile_phase_seconds_total{entry=…, phase=…}`` series:
+    ``{entry: {phase: seconds}}``. Each chunk process starts a fresh
+    registry, so a chunked campaign's per-chunk JSON attributes compile
+    cost to the chunk that actually paid it."""
+    out: Dict[str, Dict[str, float]] = {}
+    for key, v in _REGISTRY.series("compile_phase_seconds_total").items():
+        labels = dict(key)
+        entry = labels.get("entry", "?")
+        out.setdefault(entry, {})[labels.get("phase", "?")] = round(v, 4)
+    return out

@@ -60,7 +60,15 @@ import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
+from ..obs import tracing as _tracing
 from .health import HEALTH
+
+
+def _trace_injection(seam: str, mode: str, hit: int) -> None:
+    """Annotate the firing thread's active span (no-op without one): a
+    chaos run's injected faults then appear as span events inside the
+    very request/trace they hit, with matching trace IDs."""
+    _tracing.add_event("fault_injected", seam=seam, mode=mode, hit=hit)
 
 #: every registered injection seam — one per durability/transfer boundary.
 SEAMS = frozenset(
@@ -210,6 +218,7 @@ class FaultRegistry:
         if clause is None:
             return
         HEALTH.incr_fault(seam)
+        _trace_injection(seam, clause.mode, hit)
         if clause.mode == "delay":
             time.sleep(clause.delay_ms / 1000.0)
             return
@@ -228,6 +237,7 @@ class FaultRegistry:
         if clause is None:
             return blob, None
         HEALTH.incr_fault(seam)
+        _trace_injection(seam, clause.mode, hit)
         if clause.mode == "raise":
             raise FaultInjected(seam, clause.mode, hit)
         if clause.mode == "delay":

@@ -7,53 +7,96 @@ actually injected by the registry — increments a counter here.
 ``utils.reporting.service_stats_json`` and ``tools/bnb_solve.py`` surface
 the block, so a chaos run (or a production incident) leaves a
 machine-readable trace of what self-healed, not just a green exit code.
+
+Since ISSUE 6 the counters are REGISTRY-BACKED: :class:`HealthCounters`
+is a view over the process-global ``obs.metrics.REGISTRY`` series
+``health_events_total{event=…}`` / ``health_faults_injected_total{seam=…}``
+rather than its own dict, which buys snapshot/delta semantics for free —
+``SolveService`` reports :meth:`HealthCounters.delta_since` its own start
+baseline, so back-to-back serve sessions in one process no longer see
+each other's counts, and the per-test reset fixture in
+``tests/conftest.py`` gives repeated tests the same boundary.
 """
 
 from __future__ import annotations
 
-import threading
 from typing import Dict
+
+from ..obs import metrics as _metrics
+
+#: always-present counter names (scrapers see explicit zeros)
+STANDARD_COUNTERS = (
+    "worker_restarts",
+    "stuck_restarts",
+    "retries",
+    "fallback_restores",
+)
+
+EVENTS_METRIC = "health_events_total"
+FAULTS_METRIC = "health_faults_injected_total"
 
 
 class HealthCounters:
-    """Thread-safe named counters + a per-seam injected-fault tally."""
+    """Named self-healing counters + a per-seam injected-fault tally,
+    stored in the obs metrics registry (thread-safe there)."""
 
-    def __init__(self) -> None:
-        self._lock = threading.Lock()
-        self._counts: Dict[str, int] = {}
-        self._faults: Dict[str, int] = {}
+    def __init__(self, registry: _metrics.MetricsRegistry = _metrics.REGISTRY):
+        self._reg = registry
+        registry.declare(
+            EVENTS_METRIC, "counter",
+            "self-healing actions taken, by event kind",
+        )
+        registry.declare(
+            FAULTS_METRIC, "counter",
+            "faults actually injected by the TSP_FAULTS registry, by seam",
+        )
 
     def incr(self, name: str, n: int = 1) -> None:
-        with self._lock:
-            self._counts[name] = self._counts.get(name, 0) + n
+        self._reg.inc(EVENTS_METRIC, n, event=name)
 
     def incr_fault(self, seam: str) -> None:
-        with self._lock:
-            self._faults[seam] = self._faults.get(seam, 0) + 1
+        self._reg.inc(FAULTS_METRIC, 1, seam=seam)
 
     def get(self, name: str) -> int:
-        with self._lock:
-            return self._counts.get(name, 0)
+        return int(self._reg.value(EVENTS_METRIC, event=name))
 
     def snapshot(self) -> Dict:
         """One JSON-ready dict: the standard counters (always present, so
         scrapers see explicit zeros) plus any ad-hoc ones and the per-seam
         injected-fault map."""
-        with self._lock:
-            out: Dict = {
-                "worker_restarts": 0,
-                "stuck_restarts": 0,
-                "retries": 0,
-                "fallback_restores": 0,
-            }
-            out.update(self._counts)
-            out["faults_injected"] = dict(self._faults)
+        out: Dict = {k: 0 for k in STANDARD_COUNTERS}
+        for key, v in self._reg.series(EVENTS_METRIC).items():
+            out[dict(key).get("event", "?")] = int(v)
+        out["faults_injected"] = {
+            dict(key).get("seam", "?"): int(v)
+            for key, v in self._reg.series(FAULTS_METRIC).items()
+        }
+        return out
+
+    def delta_since(self, baseline: Dict) -> Dict:
+        """The same shape as :meth:`snapshot`, minus ``baseline`` (a prior
+        snapshot). Clamped at zero so a mid-window reset cannot report
+        negative healing. This is what a serve session's stats JSON
+        carries: the session's OWN recovery actions, not the process's."""
+        now = self.snapshot()
+        out: Dict = {
+            k: max(int(v) - int(baseline.get(k, 0)), 0)
+            for k, v in now.items()
+            if k != "faults_injected"
+        }
+        base_faults = baseline.get("faults_injected", {})
+        out["faults_injected"] = {
+            seam: max(int(v) - int(base_faults.get(seam, 0)), 0)
+            for seam, v in now["faults_injected"].items()
+        }
         return out
 
     def reset(self) -> None:
-        with self._lock:
-            self._counts.clear()
-            self._faults.clear()
+        self._reg.clear_metric(EVENTS_METRIC)
+        self._reg.clear_metric(FAULTS_METRIC)
+
+    #: the per-test boundary hook (tests/conftest.py autouse fixture)
+    reset_for_testing = reset
 
 
 #: the process-global instance every layer reports into.

@@ -21,6 +21,7 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from ..obs.metrics import REGISTRY as _REGISTRY
 from ..resilience.faults import registry as _fault_registry
 
 
@@ -74,13 +75,18 @@ class SolutionCache:
             entry = self._entries.get(key)
             if entry is None:
                 self.misses += 1
-                return None
-            self._entries.move_to_end(key)
-            self.hits += 1
-            return entry
+            else:
+                self._entries.move_to_end(key)
+                self.hits += 1
+        _REGISTRY.inc(
+            "serve_cache_lookups_total",
+            result="miss" if entry is None else "hit",
+        )
+        return entry
 
     def put(self, key: str, entry: CacheEntry) -> None:
         _fault_registry().fire("cache.put")
+        evicted = 0
         with self._lock:
             old = self._entries.get(key)
             if old is None or entry.better_than(old):
@@ -89,6 +95,9 @@ class SolutionCache:
             while len(self._entries) > self.capacity:
                 self._entries.popitem(last=False)
                 self.evictions += 1
+                evicted += 1
+        if evicted:
+            _REGISTRY.inc("serve_cache_evictions_total", evicted)
 
     def __len__(self) -> int:
         with self._lock:

@@ -36,6 +36,8 @@ from typing import Callable, Dict, Optional, Tuple
 
 import numpy as np
 
+from ..obs import tracing as _tracing
+from ..obs.metrics import REGISTRY as _REGISTRY
 from ..ops.distance import distance_matrix_np
 from ..ops.held_karp import MAX_BLOCK_CITIES
 from ..resilience.faults import registry as _fault_registry
@@ -200,14 +202,27 @@ class DeadlineLadder:
             base_delay_s=self.cfg.retry_base_delay_s,
             seed=0,
         )
-        try:
-            return policy.call(attempt_once, budget_s=budget_s)
-        except Exception:  # noqa: BLE001 — degrade, never error
-            with self._count_lock:
-                self.rung_failures[tier] += 1
-            return None
-        finally:
-            self.estimator.observe(tier, n, time.monotonic() - t0)
+        with _tracing.span("ladder.rung", tier=tier, n=n) as sp:
+            try:
+                result = policy.call(attempt_once, budget_s=budget_s)
+                sp.set("outcome", "ok" if result is not None else "timeout")
+                return result
+            except Exception as e:  # noqa: BLE001 — degrade, never error
+                sp.set("outcome", "failed")
+                sp.set("error", f"{type(e).__name__}: {e}")
+                with self._count_lock:
+                    self.rung_failures[tier] += 1
+                _REGISTRY.inc(
+                    "serve_rung_failures_total", tier=tier
+                )
+                return None
+            finally:
+                elapsed = time.monotonic() - t0
+                self.estimator.observe(tier, n, elapsed)
+                _REGISTRY.inc("serve_rung_attempts_total", tier=tier)
+                _REGISTRY.inc(
+                    "serve_rung_seconds_total", max(elapsed, 0.0), tier=tier
+                )
 
     def upgrade_eligible(
         self, n: int, deadline_s: float, entry_tier: str, certified_gap
@@ -261,10 +276,15 @@ class DeadlineLadder:
         caller degrades to greedy; the batch result is discarded)."""
         n = d.shape[0]
         if n <= MAX_BLOCK_CITIES:
-            ticket = self.scheduler.submit(d[None])
-            got = ticket.wait(timeout=max(budget_s, 1e-3))
-            if got is None:
-                return None
+            # the sched.wait span is the queue-wait stage of the request
+            # trace; the worker parents its flush span to it (the ticket
+            # captures this span's context at submit)
+            with _tracing.span("sched.wait", blocks=1) as sp:
+                ticket = self.scheduler.submit(d[None])
+                got = ticket.wait(timeout=max(budget_s, 1e-3))
+                if got is None:
+                    sp.set("outcome", "timeout")
+                    return None
             costs, tours = got
             return LadderResult(
                 cost=float(costs[0]),
@@ -299,10 +319,12 @@ class DeadlineLadder:
             order = np.lexsort((xy[:, 1], xy[:, 0])).astype(np.int64)
             blocks = order.reshape(n // b, b)
             block_d = d[blocks[:, :, None], blocks[:, None, :]]
-            ticket = self.scheduler.submit(block_d)
-            got = ticket.wait(timeout=max(budget_s, 1e-3))
-            if got is None:
-                return None
+            with _tracing.span("sched.wait", blocks=int(n // b)) as sp:
+                ticket = self.scheduler.submit(block_d)
+                got = ticket.wait(timeout=max(budget_s, 1e-3))
+                if got is None:
+                    sp.set("outcome", "timeout")
+                    return None
             costs, tours = got
             # fold in global (request-space) ids via the resident matrix
             global_tours = np.asarray(blocks)[
@@ -365,10 +387,12 @@ class DeadlineLadder:
                 )
         if result is None:
             # the unconditional rung: valid closed tour at ANY deadline
-            if n < 3:
-                cost, tour = _trivial_tour(n, d)
-            else:
-                cost, tour = _greedy(d)
+            with _tracing.span("ladder.rung", tier="greedy", n=n) as sp:
+                if n < 3:
+                    cost, tour = _trivial_tour(n, d)
+                else:
+                    cost, tour = _greedy(d)
+                sp.set("outcome", "ok")
             result = LadderResult(
                 cost=cost,
                 tour=tour,
@@ -377,4 +401,5 @@ class DeadlineLadder:
             )
         with self._count_lock:
             self.tier_counts[result.tier] += 1
+        _REGISTRY.inc("serve_tier_answers_total", tier=result.tier)
         return result

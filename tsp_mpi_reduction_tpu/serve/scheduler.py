@@ -44,6 +44,8 @@ from typing import Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..obs import tracing as _tracing
+from ..obs.metrics import REGISTRY as _REGISTRY
 from ..ops.held_karp import MAX_BLOCK_CITIES
 from ..resilience.faults import registry as _fault_registry
 from ..resilience.health import HEALTH
@@ -62,12 +64,16 @@ class Ticket:
     (nor vice versa)."""
 
     __slots__ = (
-        "dists", "arrived", "_event", "_costs", "_tours", "_error",
+        "dists", "arrived", "ctx", "_event", "_costs", "_tours", "_error",
         "_claim", "_done",
     )
 
     def __init__(self, dists: np.ndarray) -> None:
         self.dists = dists
+        #: the submitting thread's span context (trace_id, span_id) — the
+        #: worker parents its flush span here, so the device work a
+        #: request waited on lands in that request's own trace
+        self.ctx = _tracing.current_context()
         self.arrived = time.monotonic()
         self._event = threading.Event()
         self._costs: Optional[np.ndarray] = None
@@ -227,6 +233,7 @@ class MicroBatchScheduler:
             depth = sum(t.dists.shape[0] for t in self._queue)
             self.queue_depth_hwm = max(self.queue_depth_hwm, depth)
             self._cv.notify_all()
+        _REGISTRY.set_gauge("serve_queue_depth_blocks", depth)
         return ticket
 
     def close(self) -> None:
@@ -247,6 +254,7 @@ class MicroBatchScheduler:
             self._queue.clear()
         for t in pending:
             t._fail(RuntimeError("scheduler closed before solve"))
+        _REGISTRY.set_gauge("serve_queue_depth_blocks", 0)
 
     # -- supervision ---------------------------------------------------------
 
@@ -349,8 +357,10 @@ class MicroBatchScheduler:
                     if self._stop or pending >= self.max_batch or waited >= self.max_wait_s:
                         if pending >= self.max_batch:
                             self.full_flushes += 1
+                            _REGISTRY.inc("serve_flushes_total", cause="full")
                         else:
                             self.wait_flushes += 1
+                            _REGISTRY.inc("serve_flushes_total", cause="wait")
                         group = self._pop_group(head.dists.shape[1])
                         self._inflight = list(group)
                         return group
@@ -377,6 +387,13 @@ class MicroBatchScheduler:
             else:
                 keep.append(t)
         self._queue.extendleft(reversed(keep))
+        # keep the depth gauge honest on the DRAIN side too: submit()
+        # alone would leave the last pre-flush depth standing forever on
+        # an idle service (phantom backlog on every dashboard)
+        _REGISTRY.set_gauge(
+            "serve_queue_depth_blocks",
+            sum(t.dists.shape[0] for t in self._queue),
+        )
         return group
 
     def _worker(self, gen: int) -> None:
@@ -406,10 +423,29 @@ class MicroBatchScheduler:
 
         from ..ops.held_karp import solve_blocks_from_dists
 
-        # the sched.flush fault seam sits OUTSIDE the try: an injected
-        # raise escapes and kills the worker thread with the group still
-        # in flight — exactly the failure the watchdog must recover from
-        _fault_registry().fire("sched.flush")
+        # the sched.flush fault seam sits OUTSIDE the main try: an
+        # injected raise escapes and kills the worker thread with the
+        # group still in flight — exactly the failure the watchdog must
+        # recover from. The injection EVENT must still reach the traces:
+        # this thread has no active span (worker spans are emitted
+        # retrospectively), so the event parks in the tracing pending
+        # buffer and is attached to the flush span — including a
+        # zero-duration tombstone flush when the injection kills us.
+        try:
+            _fault_registry().fire("sched.flush")
+        except BaseException:
+            evs = _tracing.drain_pending()
+            ts = time.time()
+            for t in group:
+                _tracing.emit_span(
+                    "sched.flush", t.ctx, ts, 0.0,
+                    {"error": "fault: sched.flush"}, evs,
+                )
+            raise
+        fault_events = _tracing.drain_pending()  # delay-mode injections
+        t_flush0, ts_flush0 = time.perf_counter(), time.time()
+        dev_s = 0.0
+        error: Optional[str] = None
         try:
             stacked = np.concatenate([t.dists for t in group], axis=0)
             total = stacked.shape[0]
@@ -420,23 +456,63 @@ class MicroBatchScheduler:
                 )
                 stacked = np.concatenate([stacked, pad], axis=0)
             dtype = jnp.dtype(self.dtype)
+            t_dev0 = time.perf_counter()
             with self.timer.phase("serve.batch_solve"):
                 costs, tours = solve_blocks_from_dists(
                     jnp.asarray(stacked, dtype), dtype
                 )
                 costs_np = np.asarray(costs)
                 tours_np = np.asarray(tours)
+            dev_s = time.perf_counter() - t_dev0
             self.batches += 1
             self.blocks_solved += total
             self.padded_blocks += bucket
+            _REGISTRY.inc("serve_batches_total")
+            _REGISTRY.inc("serve_blocks_solved_total", total)
+            _REGISTRY.inc("serve_padded_lanes_total", bucket)
             off = 0
             for t in group:
                 b = t.dists.shape[0]
                 t._resolve(costs_np[off : off + b], tours_np[off : off + b])
                 off += b
         except BaseException as exc:  # noqa: BLE001 — tickets must not hang
+            error = f"{type(exc).__name__}: {exc}"
             for t in group:
                 t._fail(exc)
+        finally:
+            self._emit_flush_spans(
+                group, ts_flush0, t_flush0, dev_s, error, fault_events
+            )
+
+    def _emit_flush_spans(
+        self, group, ts0: float, t0: float, dev_s: float, error,
+        events=None,
+    ) -> None:
+        """One ``sched.flush`` span (+ a ``device.dispatch`` child) per
+        ticket that carried a trace context — the flush is shared device
+        work, but each request's trace must stand alone, so it is
+        recorded once per participating trace (attrs carry the shared
+        batch shape so a reader can re-correlate them)."""
+        if not _tracing.TRACER.active:
+            return
+        dur_s = time.perf_counter() - t0
+        total = sum(t.dists.shape[0] for t in group)
+        for t in group:
+            attrs = {
+                "batch_blocks": total,
+                "batch_tickets": len(group),
+                "ticket_blocks": int(t.dists.shape[0]),
+            }
+            if error is not None:
+                attrs["error"] = error
+            fctx = _tracing.emit_span(
+                "sched.flush", t.ctx, ts0, dur_s, attrs, events
+            )
+            if fctx is not None and dev_s > 0.0:
+                _tracing.emit_span(
+                    "device.dispatch", fctx, ts0 + (dur_s - dev_s), dev_s,
+                    {"seconds": round(dev_s, 6)},
+                )
 
     # -- stats ---------------------------------------------------------------
 

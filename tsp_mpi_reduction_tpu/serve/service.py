@@ -44,6 +44,8 @@ from typing import Dict, IO, Iterable, List, Optional
 
 import numpy as np
 
+from ..obs import tracing as _tracing
+from ..obs.metrics import REGISTRY as _REGISTRY
 from ..resilience.faults import TransientFault
 from ..resilience.health import HEALTH
 from ..resilience.retry import RetryPolicy
@@ -73,6 +75,10 @@ class ServiceConfig:
     #: no request ever pays a cold XLA compile inside its flush. Empty =
     #: no warmup (the pre-PR-5 behavior)
     warm_shapes: tuple = ()
+    #: the bound metrics endpoint port (set by serve_cli when
+    #: --metrics-port is given) — surfaced in the stats ``obs`` block so
+    #: a log line names its own scrape target
+    metrics_port: Optional[int] = None
     ladder: LadderConfig = field(default_factory=LadderConfig)
 
 
@@ -81,7 +87,9 @@ class SolveService:
 
     def __init__(self, cfg: Optional[ServiceConfig] = None) -> None:
         self.cfg = cfg or ServiceConfig()
-        self.timer = PhaseTimer()  # shared across worker + request threads
+        # shared across worker + request threads; phases mirror into the
+        # obs registry alongside every other serve signal
+        self.timer = PhaseTimer(mirror_metric="phase_seconds_total")
         self.cache = SolutionCache(self.cfg.cache_capacity)
         self.scheduler = MicroBatchScheduler(
             max_batch=self.cfg.max_batch,
@@ -98,6 +106,10 @@ class SolveService:
         self.canon_cache = canon.CanonicalCache(self.cfg.cache_capacity)
         if self.cfg.warm_shapes:
             self.scheduler.precompile(self.cfg.warm_shapes)
+        #: health baseline at service start: the stats JSON reports the
+        #: DELTA, so back-to-back sessions in one process (tests, bench
+        #: legs, embedded services) stop seeing each other's recoveries
+        self._health0 = HEALTH.snapshot()
         self.responses = 0
         self.errors = 0
         self.deadline_misses = 0
@@ -110,6 +122,7 @@ class SolveService:
     def _record_error(self) -> None:
         with self._stats_lock:
             self.errors += 1
+        _REGISTRY.inc("serve_errors_total")
 
     # a transient cache fault (the cache.get/cache.put seams) must never
     # cost a request its answer: retry briefly, then degrade — a failed
@@ -132,6 +145,19 @@ class SolveService:
     # -- one request ---------------------------------------------------------
 
     def handle(self, request: Dict) -> Dict:
+        # root span of this request's trace: every stage below (cache
+        # lookup, ladder rung, queue wait, the worker's flush) parents
+        # back to it, so one serve request = one complete span tree —
+        # error/degraded paths included (the finally-emitted root closes
+        # the tree either way)
+        with _tracing.span("serve.request", id=request.get("id")) as root:
+            resp = self._handle_traced(request, root)
+            root.set("tier", resp.get("tier"))
+            if "error" in resp:
+                root.set("error", resp["error"])
+            return resp
+
+    def _handle_traced(self, request: Dict, root) -> Dict:
         t0 = time.monotonic()
         req_id = request.get("id")
         try:
@@ -139,7 +165,9 @@ class SolveService:
             deadline_ms = float(
                 request.get("deadline_ms", self.cfg.default_deadline_ms)
             )
-            with self.timer.phase("serve.canonicalize"):
+            with self.timer.phase("serve.canonicalize"), _tracing.span(
+                "canonicalize"
+            ):
                 ci = canon.canonicalize_cached(
                     xy, self.canon_cache, self.cfg.quant_step
                 )
@@ -147,7 +175,9 @@ class SolveService:
             self._record_error()
             return {"id": req_id, "error": str(e)}
 
-        entry = self._cache_get(ci.key)
+        with _tracing.span("cache.lookup") as csp:
+            entry = self._cache_get(ci.key)
+            csp.set("result", "miss" if entry is None else "hit")
         # a non-exact cached answer does not pin the instance forever: a
         # request whose budget fits a STRONGER rung re-solves ("refresh")
         # and the cache's better-entry policy keeps whichever tour wins
@@ -192,18 +222,23 @@ class SolveService:
             self.responses += 1
             if missed:
                 self.deadline_misses += 1
-        return {
-            "id": req_id,
-            "n": int(xy.shape[0]),
-            "cost": float(cost),
-            "tour": [int(c) for c in tour],
-            "tier": tier,
-            "certified_gap": None if gap is None else float(gap),
-            "cache": provenance,
-            "latency_ms": round(latency_ms, 3),
-            "deadline_ms": deadline_ms,
-            "deadline_missed": bool(missed),
-        }
+        _REGISTRY.inc("serve_responses_total", cache=provenance)
+        if missed:
+            _REGISTRY.inc("serve_deadline_misses_total")
+        _REGISTRY.observe("serve_request_seconds", latency_ms / 1000.0)
+        with _tracing.span("respond"):
+            return {
+                "id": req_id,
+                "n": int(xy.shape[0]),
+                "cost": float(cost),
+                "tour": [int(c) for c in tour],
+                "tier": tier,
+                "certified_gap": None if gap is None else float(gap),
+                "cache": provenance,
+                "latency_ms": round(latency_ms, 3),
+                "deadline_ms": deadline_ms,
+                "deadline_missed": bool(missed),
+            }
 
     # -- stats / lifecycle ---------------------------------------------------
 
@@ -228,8 +263,14 @@ class SolveService:
             cache=cache_stats,
             scheduler=self.scheduler.stats(),
             phases_s=dict(self.timer.seconds),
-            health=HEALTH.snapshot(),
+            # THIS session's recoveries, not the process's lifetime count
+            # (registry-backed delta; see resilience.health)
+            health=HEALTH.delta_since(self._health0),
             compile_cache=perf_cache.stats_dict(),
+            obs=reporting.obs_block(
+                trace_path=_tracing.TRACER.path,
+                metrics_port=self.cfg.metrics_port,
+            ),
         )
 
     def close(self) -> None:
@@ -369,6 +410,15 @@ def serve_cli(argv: Optional[List[str]] = None) -> int:
                     "AOT-warmed so no request pays a cold XLA compile")
     ap.add_argument("--stats", action="store_true",
                     help="print the service stats JSON line to stderr on exit")
+    ap.add_argument("--trace", default=None, metavar="FILE",
+                    help="span-trace JSONL sink: every request emits a "
+                    "span tree (request -> canonicalize -> cache lookup "
+                    "-> queue wait -> flush -> rung -> respond); render "
+                    "with tools/obs_report.py (env: TSP_TRACE)")
+    ap.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
+                    help="serve the obs metrics registry over HTTP on "
+                    "127.0.0.1:PORT (/metrics = Prometheus text "
+                    "exposition, /metrics.json = structured snapshot)")
     args = ap.parse_args(argv)
 
     from ..utils.backend import enable_persistent_cache, select_backend
@@ -399,6 +449,22 @@ def serve_cli(argv: Optional[List[str]] = None) -> int:
     # closed) AND the error path of a file sink before its close.
     from contextlib import ExitStack
 
+    if args.trace:
+        _tracing.configure(args.trace)
+    metrics_server = None
+    if args.metrics_port is not None:
+        from ..obs.metrics import serve_metrics_http
+
+        try:
+            metrics_server = serve_metrics_http(args.metrics_port)
+        except OSError as e:
+            print(f"error: cannot bind metrics port: {e}", file=sys.stderr)
+            return 2
+        cfg.metrics_port = metrics_server.server_address[1]
+        print(
+            f"metrics: http://127.0.0.1:{cfg.metrics_port}/metrics",
+            file=sys.stderr,
+        )
     with ExitStack() as stack:
         inp = sys.stdin if args.inp == "-" else stack.enter_context(open(args.inp))
         outp = (
@@ -415,6 +481,8 @@ def serve_cli(argv: Optional[List[str]] = None) -> int:
                 outp.flush()
             except (OSError, ValueError):
                 pass  # broken pipe / already closed: nothing left to save
+            if metrics_server is not None:
+                metrics_server.shutdown()
     if args.stats:
         print(svc.stats_json(), file=sys.stderr)
     return 0

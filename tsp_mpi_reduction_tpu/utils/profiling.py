@@ -5,7 +5,14 @@ everything including ``MPI_Init`` (tsp.cpp:275-276,360-363). Here every
 pipeline reports per-phase seconds (``PipelineResult.phase_seconds``), DP
 state/transition counts (the north-star nodes/sec metric), and — via
 ``device_trace`` — full ``jax.profiler`` traces viewable in TensorBoard /
-Perfetto for kernel-level TPU timing.
+Perfetto for kernel-level TPU timing, segmented per B&B expansion step by
+``obs.tracing.step_annotation`` while a capture is active.
+
+Phase timers optionally MIRROR into the obs metrics registry
+(``obs.metrics.REGISTRY``): construct with ``mirror_metric="…"`` and every
+accumulated phase also lands as a labeled counter series, so scrapers and
+the stats JSON read phases from the same source of truth as every other
+signal. Mirroring is skipped under ``TSP_OBS=off``.
 """
 
 from __future__ import annotations
@@ -31,10 +38,16 @@ class PhaseTimer:
     into ``seconds`` holds a lock (the measurement window itself does not —
     overlapping phases from different threads accumulate independently and
     can legitimately sum past wall-clock time).
+
+    ``mirror_metric``: when set, every :meth:`add` also increments the
+    counter series ``<mirror_metric>{phase=<name>}`` in the process-global
+    obs registry — the phase table then has registry-backed snapshot/delta
+    semantics alongside the local dict.
     """
 
-    def __init__(self) -> None:
+    def __init__(self, mirror_metric: Optional[str] = None) -> None:
         self.seconds: Dict[str, float] = {}
+        self.mirror_metric = mirror_metric
         self._lock = threading.Lock()
 
     @contextlib.contextmanager
@@ -51,13 +64,32 @@ class PhaseTimer:
         ``lower().compile()`` calls) that still belong in one phase table."""
         with self._lock:
             self.seconds[name] = self.seconds.get(name, 0.0) + seconds
+        if self.mirror_metric:
+            from ..obs import enabled as _obs_enabled
+            from ..obs.metrics import REGISTRY
+
+            if _obs_enabled():
+                REGISTRY.inc(self.mirror_metric, max(seconds, 0.0), phase=name)
 
 
 #: process-global timer for compile/AOT-load costs (perf.compile_cache
 #: records into it; drivers fold it into their phase output) — compile
 #: seconds are process-scoped, not per-request, so they get one shared
-#: accumulator rather than riding any single request's PhaseTimer
-COMPILE_TIMER = PhaseTimer()
+#: accumulator rather than riding any single request's PhaseTimer.
+#: perf.compile_cache ADDITIONALLY records per-entry compile seconds into
+#: the obs registry (``compile_phase_seconds_total{entry=…, phase=…}``),
+#: which is what chunked campaigns read to attribute compile cost per
+#: chunk — a destructive "whoever reads the timer first" fold is gone.
+COMPILE_TIMER = PhaseTimer(mirror_metric="phase_seconds_total")
+
+#: is a ``device_trace`` capture currently running? (obs.tracing's
+#: ``step_annotation`` checks this so per-dispatch StepTraceAnnotations
+#: exist exactly when there is a profiler to consume them)
+_TRACE_ACTIVE = False
+
+
+def trace_active() -> bool:
+    return _TRACE_ACTIVE
 
 
 @contextlib.contextmanager
@@ -65,12 +97,20 @@ def device_trace(trace_dir: Optional[str]) -> Iterator[None]:
     """``jax.profiler.trace`` scoped to the block; no-op when dir is None.
 
     The dump is TensorBoard-loadable (``tensorboard --logdir <dir>``) and
-    includes XLA kernel timelines on TPU.
+    includes XLA kernel timelines on TPU. While the capture is active,
+    the B&B host loops wrap every dispatch in ``StepTraceAnnotation`` (via
+    ``obs.tracing.step_annotation``), so the timeline segments by
+    expansion step.
     """
+    global _TRACE_ACTIVE
     if not trace_dir:
         yield
         return
     import jax
 
-    with jax.profiler.trace(trace_dir):
-        yield
+    _TRACE_ACTIVE = True
+    try:
+        with jax.profiler.trace(trace_dir):
+            yield
+    finally:
+        _TRACE_ACTIVE = False

@@ -72,6 +72,27 @@ def metrics_json(
     return json.dumps(payload)
 
 
+def obs_block(
+    *,
+    trace_path: Optional[str] = None,
+    metrics_port: Optional[int] = None,
+) -> Dict:
+    """The ``obs`` sub-block shared by driver and serve stats JSON: where
+    the telemetry layer is writing (trace sink, metrics endpoint), whether
+    the optional-overhead half is enabled, and the per-entry compile phase
+    attribution read back from the metrics registry — the single source
+    of truth the bespoke builders now assemble FROM (ISSUE 6)."""
+    from ..obs import enabled as obs_enabled
+    from ..perf.compile_cache import compile_phase_seconds
+
+    return {
+        "enabled": obs_enabled(),
+        "trace": trace_path,
+        "metrics_port": metrics_port,
+        "compile_phases_s": compile_phase_seconds(),
+    }
+
+
 def service_stats_json(
     *,
     responses: int,
@@ -85,6 +106,7 @@ def service_stats_json(
     rung_failures: Optional[Dict[str, int]] = None,
     health: Optional[Dict] = None,
     compile_cache: Optional[Dict] = None,
+    obs: Optional[Dict] = None,
 ) -> str:
     """Machine-readable serve-layer counters (SpillStats-style): per-tier
     answer counts, cache hit/miss/eviction totals plus the derived hit
@@ -109,5 +131,6 @@ def service_stats_json(
         "phases_s": phases_s or {},
         "health": health or {},
         "compile_cache": compile_cache or {},
+        "obs": obs or {},
     }
     return json.dumps(payload)
