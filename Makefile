@@ -18,11 +18,17 @@ CXXFLAGS ?= -O2 -std=c++11
 
 all: lint native oracle chaos
 
-# --- static analysis: graftlint (JAX-hazard rules R1-R5, see README) plus
-# ruff when available (ruff.toml pins a minimal critical-error set; the
-# container image has no ruff, so fall back to a syntax-only compile check).
-# The default target set covers the whole package — including the serve/
-# layer, which the zero-entry baseline ratchet holds to no hot-path debt.
+# --- static analysis: one gate, two passes against ONE shared baseline —
+# graftlint (syntactic AST rules R1-R8) + graftflow (interprocedural
+# dataflow rules R9-R12: lock-discipline races, use-after-donate,
+# static-arg recompile risk, shard_map axis-name drift; see README). The
+# CLI runs both and FAILS on new findings of either pass and on dead
+# baseline scopes for any rule. Plus ruff when available (ruff.toml pins
+# a minimal critical-error set; the container image has no ruff, so fall
+# back to a syntax-only compile check). The default target set covers the
+# whole package — including the serve/ layer, which the zero-entry
+# baseline ratchet holds to no hot-path debt. `--sarif out.sarif` /
+# tools/lint_report.py produce the CI-facing artifacts.
 lint:
 	$(PY) -m tsp_mpi_reduction_tpu.analysis
 	@if command -v ruff >/dev/null 2>&1; then ruff check .; \

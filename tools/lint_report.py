@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""CI lint digest: per-rule counts + baseline deltas for the combined
+graftlint (R1-R8) + graftflow (R9-R12) run.
+
+``make lint`` already fails the build on new findings; this tool exists
+for the CI LOG — one table a human can read in the job output (and one
+optional SARIF artifact for inline annotations) without re-running the
+passes locally:
+
+    python tools/lint_report.py [paths...] [--sarif out.sarif]
+
+Prints, per rule: new findings, baseline-accepted sites, and the rule's
+one-line hazard description; then the baseline delta block (stale entries
+= fixed-but-still-listed, dead entries = scope gone, the ratchet's fail
+condition). Exit code mirrors the gate: 0 clean, 1 new/dead, 2 usage.
+
+Stdout-only (plus the explicit --sarif artifact): a report tool must not
+write surprise files into a CI workspace.
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import sys
+
+_REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(_REPO))
+
+from tsp_mpi_reduction_tpu.analysis.__main__ import (  # noqa: E402
+    ALL_RULES,
+    _DEFAULT_BASELINE,
+    _DEFAULT_TARGETS,
+    _REPO_ROOT,
+    run_analyses,
+)
+from tsp_mpi_reduction_tpu.analysis.graftlint import (  # noqa: E402
+    apply_baseline,
+    find_dead_scopes,
+    load_baseline,
+)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="lint_report", description=__doc__.splitlines()[0]
+    )
+    ap.add_argument("paths", nargs="*", type=pathlib.Path)
+    ap.add_argument("--baseline", type=pathlib.Path, default=_DEFAULT_BASELINE)
+    ap.add_argument("--sarif", type=pathlib.Path, default=None,
+                    help="also write NEW findings as a SARIF 2.1.0 log")
+    args = ap.parse_args(argv)
+
+    if args.paths:
+        missing = [p for p in args.paths if not p.exists()]
+        if missing:
+            print("lint_report: no such path(s): "
+                  + ", ".join(str(p) for p in missing))
+            return 2
+        targets = list(args.paths)
+    else:
+        targets = [p for p in _DEFAULT_TARGETS if p.exists()]
+
+    violations = run_analyses(targets, set(ALL_RULES))
+    baseline = load_baseline(args.baseline)
+    res = apply_baseline(violations, baseline)
+    dead = find_dead_scopes(baseline, _REPO_ROOT)
+    stale = [fp for fp in res.stale if fp not in set(dead)]
+
+    if args.sarif is not None:
+        from tsp_mpi_reduction_tpu.analysis.sarif import write_sarif
+
+        write_sarif(args.sarif, res.new, ALL_RULES)
+
+    per_rule = {rid: [0, 0] for rid in ALL_RULES}
+    for v in res.new:
+        per_rule.setdefault(v.rule, [0, 0])[0] += 1
+    for v in res.accepted:
+        per_rule.setdefault(v.rule, [0, 0])[1] += 1
+
+    print(f"lint report — {len(targets)} target(s), rules R1-R12 "
+          "(graftlint syntactic + graftflow dataflow)")
+    print(f"{'rule':<5} {'new':>4} {'base':>5}  hazard")
+    for rid in sorted(per_rule, key=lambda r: int(r[1:])):
+        new_n, base_n = per_rule[rid]
+        marker = " <-- FIX OR DISABLE" if new_n else ""
+        print(f"{rid:<5} {new_n:>4} {base_n:>5}  {ALL_RULES[rid]}{marker}")
+    for v in res.new:
+        print(f"  {v.path}:{v.line}: {v.rule} [{v.scope}] {v.message}")
+
+    print(
+        f"baseline: {len(baseline)} entries, {len(res.accepted)} matched, "
+        f"{len(stale)} stale, {len(dead)} dead"
+    )
+    for fp in stale:
+        print(f"  stale (fixed? regenerate): {fp}")
+    for fp in dead:
+        print(f"  DEAD (scope gone — delete or regenerate): {fp}")
+    if args.sarif is not None:
+        print(f"sarif: {len(res.new)} result(s) -> {args.sarif}")
+    verdict = "FAIL" if (res.new or dead) else "ok"
+    print(f"verdict: {verdict} ({len(res.new)} new, {len(dead)} dead)")
+    return 1 if (res.new or dead) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,20 +1,35 @@
 """Static analysis + runtime contracts for the JAX engine.
 
-Two layers, one goal — stop the ADVICE.md hazard classes from regressing
+Three layers, one goal — stop the ADVICE.md hazard classes from regressing
 silently:
 
-- ``graftlint``: stdlib-only AST lint (rules R1-R5) over the package; CLI is
-  ``python -m tsp_mpi_reduction_tpu.analysis`` (wired into ``make lint``).
+- ``graftlint``: stdlib-only per-node AST lint (rules R1-R8) over the
+  package; syntactic pass.
+- ``graftflow``: stdlib-only interprocedural DATAFLOW lint (rules R9-R12)
+  over the same surface — per-function CFG walks plus a project-wide
+  call/thread-reachability graph (lock-discipline races, use-after-donate,
+  static-arg recompile risk, shard_map axis-name drift). Shares
+  graftlint's disable-comment grammar and baseline file.
 - ``contracts``: cheap runtime shape/dtype contracts on the Frontier /
   PaddedTour boundaries plus a jit recompilation guard for fixed-shape hot
   loops (wired into tier-1 tests).
 
-``graftlint`` must stay importable without JAX (it runs before any backend
-exists), so this package init deliberately does NOT import ``contracts``
-eagerly — import it as ``from tsp_mpi_reduction_tpu.analysis import
-contracts`` where needed.
+The CLI ``python -m tsp_mpi_reduction_tpu.analysis`` (wired into
+``make lint``) runs BOTH static passes against the one shared baseline;
+``--json`` adds per-rule counts, ``--sarif PATH`` emits SARIF 2.1.0.
+
+``graftlint``/``graftflow`` must stay importable without JAX (they run
+before any backend exists), so this package init deliberately does NOT
+import ``contracts`` eagerly — import it as ``from
+tsp_mpi_reduction_tpu.analysis import contracts`` where needed.
 """
 
+from .graftflow import (  # noqa: F401
+    FLOW_RULES,
+    flow_paths,
+    flow_project,
+    flow_text,
+)
 from .graftlint import (  # noqa: F401
     RULES,
     Violation,

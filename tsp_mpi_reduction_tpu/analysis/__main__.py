@@ -1,25 +1,41 @@
 """CLI: ``python -m tsp_mpi_reduction_tpu.analysis [paths...]``.
 
-Exit status 0 when the tree is clean modulo the checked-in baseline,
-1 when new violations exist, 2 on usage errors. Runs stdlib-only (no JAX
+Runs BOTH analysis passes over the same surface against one shared
+baseline: graftlint (per-node AST rules R1-R8) and graftflow (the
+interprocedural dataflow rules R9-R12). Exit status 0 when the tree is
+clean modulo the checked-in baseline, 1 when new violations or dead
+baseline entries exist, 2 on usage errors. Runs stdlib-only (no JAX
 import), so it is safe as the first stage of ``make lint`` / the sweep
 harness even on machines with no accelerator runtime.
+
+Machine-readable outputs:
+
+- ``--json``: one JSON object on stdout with PER-RULE new/baselined
+  counts plus stale/dead fingerprints — the Makefile ratchet (and
+  ``tools/lint_report.py``) can then distinguish "new R9 finding" from
+  "stale baseline entry" without scraping the text report.
+- ``--sarif PATH``: the combined run's NEW findings as a SARIF 2.1.0 log
+  (CI annotation ingestion; rule catalog embedded).
 """
 
 from __future__ import annotations
 
-import argparse
+import json
 import pathlib
 import sys
 
+from .graftflow import FLOW_RULES, flow_project
 from .graftlint import (
     RULES,
+    _iter_py_files,
     apply_baseline,
     find_dead_scopes,
-    lint_paths,
+    lint_text,
     load_baseline,
     write_baseline,
 )
+
+ALL_RULES = {**RULES, **FLOW_RULES}
 
 _PKG_DIR = pathlib.Path(__file__).resolve().parent.parent  # the package
 _REPO_ROOT = _PKG_DIR.parent
@@ -30,9 +46,53 @@ _DEFAULT_BASELINE = pathlib.Path(__file__).resolve().parent / "graftlint_baselin
 _DEFAULT_TARGETS = [_PKG_DIR, _REPO_ROOT / "tools", _REPO_ROOT / "bench.py"]
 
 
+def run_analyses(targets, rules):
+    """Both passes over ``targets``; one combined, ordered violation list.
+
+    The surface is read ONCE and the {path: source} map is fed to both
+    passes — the two-pass gate must not pay double file I/O + ast.parse
+    (the <= 10 s wall budget is a tier-1 acceptance)."""
+    lint_rules = rules & set(RULES)
+    flow_rules = rules & set(FLOW_RULES)
+    sources = {}
+    for f in _iter_py_files(targets):
+        try:
+            source = f.read_text()
+        except (OSError, UnicodeDecodeError):
+            continue
+        try:
+            rel = f.resolve().relative_to(_REPO_ROOT.resolve()).as_posix()
+        except ValueError:
+            rel = f.as_posix()
+        sources[rel] = source
+    violations = []
+    if lint_rules:
+        for rel, source in sources.items():
+            try:
+                violations.extend(lint_text(source, rel, rules=lint_rules))
+            except SyntaxError:
+                continue
+    if flow_rules:
+        violations.extend(flow_project(sources, rules=flow_rules))
+    violations.sort(key=lambda v: (v.path, v.line, v.rule))
+    return violations
+
+
+def _per_rule_counts(res) -> dict:
+    out = {rid: {"new": 0, "baselined": 0} for rid in sorted(ALL_RULES)}
+    for v in res.new:
+        out.setdefault(v.rule, {"new": 0, "baselined": 0})["new"] += 1
+    for v in res.accepted:
+        out.setdefault(v.rule, {"new": 0, "baselined": 0})["baselined"] += 1
+    return out
+
+
 def main(argv=None) -> int:
+    import argparse
+
     ap = argparse.ArgumentParser(
-        prog="graftlint", description="JAX-hazard lint (rules R1-R7)"
+        prog="graftlint",
+        description="JAX-hazard lint: graftlint (R1-R8) + graftflow (R9-R12)",
     )
     ap.add_argument(
         "paths",
@@ -42,14 +102,14 @@ def main(argv=None) -> int:
     )
     ap.add_argument(
         "--rules",
-        default=",".join(sorted(RULES)),
-        help="comma-separated rule subset (default: all)",
+        default=",".join(sorted(ALL_RULES)),
+        help="comma-separated rule subset (default: all of R1-R12)",
     )
     ap.add_argument(
         "--baseline",
         type=pathlib.Path,
         default=_DEFAULT_BASELINE,
-        help="baseline JSON of accepted sites",
+        help="baseline JSON of accepted sites (shared by both passes)",
     )
     ap.add_argument(
         "--no-baseline",
@@ -62,12 +122,24 @@ def main(argv=None) -> int:
         help="accept the current violations as the new baseline",
     )
     ap.add_argument(
+        "--json",
+        action="store_true",
+        help="machine-readable summary with per-rule counts on stdout",
+    )
+    ap.add_argument(
+        "--sarif",
+        type=pathlib.Path,
+        default=None,
+        metavar="PATH",
+        help="write NEW findings as a SARIF 2.1.0 log for CI annotations",
+    )
+    ap.add_argument(
         "--quiet", action="store_true", help="summary line only"
     )
     args = ap.parse_args(argv)
 
     rules = {r.strip() for r in args.rules.split(",") if r.strip()}
-    unknown = rules - set(RULES)
+    unknown = rules - set(ALL_RULES)
     if unknown:
         print(f"graftlint: unknown rule(s): {', '.join(sorted(unknown))}")
         return 2
@@ -85,9 +157,18 @@ def main(argv=None) -> int:
         targets = list(args.paths)
     else:
         targets = [p for p in _DEFAULT_TARGETS if p.exists()]
-    violations = lint_paths(targets, root=_REPO_ROOT, rules=rules)
+    violations = run_analyses(targets, rules)
 
     if args.write_baseline:
+        if args.json or args.sarif is not None:
+            # --write-baseline short-circuits reporting: honoring the
+            # combination silently (no SARIF file, non-JSON stdout) would
+            # break whatever pipeline asked for it — refuse loudly
+            print(
+                "graftlint: --write-baseline cannot be combined with "
+                "--json/--sarif (it writes the baseline and exits)"
+            )
+            return 2
         if args.paths and args.baseline == _DEFAULT_BASELINE:
             # a partial lint surface must not clobber the repo-wide
             # baseline (it would drop every accepted site outside `paths`)
@@ -109,9 +190,42 @@ def main(argv=None) -> int:
     # the source can never be repaid — it only masks a future violation
     # that happens to reuse the fingerprint. Fail, don't warn. A dead
     # entry necessarily also matched no violation, so drop it from the
-    # (warn-only) stale list — one entry, one verdict.
+    # (warn-only) stale list — one entry, one verdict. Applies to both
+    # passes: the fingerprints share one grammar and one file.
     dead = find_dead_scopes(baseline, _REPO_ROOT)
     stale = [fp for fp in res.stale if fp not in set(dead)]
+
+    if args.sarif is not None:
+        from .sarif import write_sarif
+
+        write_sarif(args.sarif, res.new, ALL_RULES)
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "new": len(res.new),
+                    "baselined": len(res.accepted),
+                    "stale": stale,
+                    "dead": dead,
+                    "per_rule": _per_rule_counts(res),
+                    "targets": len(targets),
+                    "rules": sorted(rules),
+                    "violations": [
+                        {
+                            "path": v.path,
+                            "line": v.line,
+                            "rule": v.rule,
+                            "scope": v.scope,
+                            "message": v.message,
+                        }
+                        for v in res.new
+                    ],
+                },
+                indent=2,
+            )
+        )
+        return 1 if (res.new or dead) else 0
 
     if not args.quiet:
         for v in res.new:

@@ -1,0 +1,1984 @@
+"""graftflow: flow-aware, interprocedural dataflow lint (rules R9-R12).
+
+graftlint (the sibling pass) judges one AST node at a time; the hazard
+classes below are invisible at that altitude because the *defect* is a
+relationship between program points — an attribute access and the lock
+that guards it three methods away, a buffer use and the jit dispatch that
+consumed it ten lines earlier, a collective and the shard_map wrapper one
+closure out. graftflow builds a small per-project dataflow IR instead:
+
+- a **module index** (imports resolved across the lint surface, classes
+  with their lock attributes and field types, every function/method with
+  its qualified scope);
+- a **call graph** with cheap type resolution (``self.m()``,
+  ``self.field.m()`` via constructor/annotation field types, bare and
+  module-aliased calls, locals assigned ``ClassName(...)``);
+- a **thread-reachability closure** seeded at thread entry points
+  (``threading.Thread(target=...)`` and ``executor.submit(f, ...)``
+  with a function reference);
+- per-function **flow state**: lexically-held locks (propagated into
+  ``*_locked`` helpers via the intersection of held-locks at their call
+  sites) and a donated-binding lattice walked over the statement graph.
+
+The four rules (RacerD's compositional lock-consistency analysis and
+NeuraLint's framework-pitfall graph rules are the ancestry — PAPERS.md):
+
+  R9  lock-discipline race: each class's ``attribute -> guarding lock``
+      map is inferred from writes performed while holding a lock
+      (``with self._lock:`` blocks, plus methods only ever called with
+      the lock held). In a class whose methods run on more than one
+      thread (it spawns threads, or is reachable from a thread entry
+      point), any access to a guarded attribute without that lock is a
+      data race — including cross-object reads like
+      ``self.ladder.tier_counts`` from another class. ``__init__`` is
+      exempt (pre-publication), and the double-checked-locking idiom (an
+      unlocked read re-checked under the same lock in the same method)
+      is recognized, not flagged.
+  R10 use-after-donate: a call into a donating jit entry (the R7
+      registry: ``donate_argnums``/``donate_argnames``) CONSUMES the
+      argument buffer — jax deletes the caller's handle. Any later use
+      of that binding on any path that isn't a rebind from the call's
+      result is flagged. ``contracts.check_donated`` is the sanctioned
+      post-dispatch consumer check and is exempt. This makes the PR 5
+      consumed-handle contract (today a runtime check on whatever paths
+      a test happens to execute) a compile-time guarantee.
+  R11 static-arg recompile risk: a jit entry's ``static_argnames`` /
+      ``static_argnums`` parameter bound at a call site to an unhashable
+      value (list/dict/set displays, comprehensions, numpy/jnp arrays —
+      a TypeError at dispatch) or a per-call-varying one (f-strings,
+      loop variables of unbounded loops — one silent XLA recompile per
+      distinct value: the static sibling of the RecompilationGuard).
+      Loop variables of bounded literal/range loops are the sanctioned
+      precompile pattern (``scheduler.precompile``) and stay quiet.
+  R12 collective/axis-name consistency: ``psum``/``ppermute``/
+      ``all_gather``/``axis_index``/``pcast_varying``/... inside a
+      ``shard_map`` body must name an axis the wrapping site declares
+      (``P(...)`` specs, resolved through module constants like
+      ``RANK_AXIS`` across files). A drifted axis name is an obscure
+      trace-time error today and a wrong-mesh collective after the
+      ROADMAP's 2D-mesh refactor. Sites whose axis names cannot be
+      resolved statically are skipped, never guessed.
+
+Escape hatches and baseline are SHARED with graftlint: the same
+``# graftlint: disable=R9`` comment grammar (same line, line above, or
+the ``def`` line), and the same ``graftlint_baseline.json`` fingerprint
+machinery — one gate, one ratchet, one zero-entry contract.
+
+Like graftlint, this pass is stdlib-only (``ast`` + ``tokenize``) and
+must never import jax: it runs first in ``make lint`` on machines with
+no accelerator runtime.
+
+Known over-approximations (deliberate, lint-grade): method resolution is
+name+type-based with no inheritance walk; objects handed around as bare
+parameters are untyped (their classes are only checked when reached some
+other way); module-global locks guarding module-global state are out of
+the class-attribute model. Each limitation loses findings, not precision.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .graftlint import (
+    Violation,
+    _Directives,
+    _dotted,
+    _iter_py_files,
+    _jit_call_parts,
+    _traced_callee_names,
+)
+
+FLOW_RULES = {
+    "R9": "thread-shared attribute accessed outside its guarding lock",
+    "R10": "use of a buffer binding after it was donated to a jit entry",
+    "R11": "jit static arg bound to an unhashable or per-call-varying value",
+    "R12": "collective axis name not declared by the enclosing shard_map",
+}
+
+#: lock/condition factories whose targets become guard attributes
+_LOCK_FACTORIES = frozenset(
+    {"Lock", "RLock", "Condition"}
+)
+#: collective -> positional index of its axis-name argument
+_AXIS_ARG = {
+    "psum": 1, "pmin": 1, "pmax": 1, "pmean": 1, "ppermute": 1,
+    "all_gather": 1, "psum_scatter": 1, "pbroadcast": 1, "all_to_all": 1,
+    "pshuffle": 1, "pswapaxes": 1, "axis_index": 0, "axis_size": 0,
+    "pcast_varying": 1,
+}
+#: spellings of the partition-spec constructor inside in_specs/out_specs
+_SPEC_NAMES = frozenset({"P", "PartitionSpec"})
+#: dotted-name suffixes exempt from R10 use checks (the sanctioned
+#: post-dispatch consumer check reads the DELETED handle on purpose)
+_DONATE_CHECK_SUFFIX = "check_donated"
+#: numpy/array-producing roots whose results are unhashable (R11)
+_ARRAY_ROOTS = frozenset({"np", "numpy", "jnp", "jax"})
+#: method names that MUTATE their receiver (R9: ``self.q.append(x)`` is a
+#: write to ``q`` for guard inference, like ``self.d[k] = v``)
+_MUTATORS = frozenset(
+    {
+        "append", "extend", "insert", "add", "update", "pop", "popitem",
+        "popleft", "appendleft", "extendleft", "remove", "discard",
+        "clear", "setdefault", "sort", "reverse", "move_to_end",
+    }
+)
+
+
+# -- IR dataclasses -----------------------------------------------------------
+
+
+@dataclass
+class Access:
+    """One ``self.attr`` (or ``self.field.attr``) touch inside a method."""
+
+    attr: str
+    write: bool
+    line: int
+    method: str  # method name within the class
+    held: frozenset  # lock attrs lexically held
+    node: ast.AST
+    #: for cross-object accesses: the ``self.<field>`` the attr hangs off
+    via_field: Optional[str] = None
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    module: "ModuleInfo"
+    node: ast.ClassDef
+    lock_attrs: Set[str] = field(default_factory=set)
+    #: self.<field> -> (module_path, class_name) when statically known
+    field_types: Dict[str, Tuple[str, str]] = field(default_factory=dict)
+    methods: Dict[str, ast.AST] = field(default_factory=dict)
+    properties: Set[str] = field(default_factory=set)
+    accesses: List[Access] = field(default_factory=list)
+    #: method -> [(callee method, held locks at the call)] intra-class
+    intra_calls: Dict[str, List[Tuple[str, frozenset]]] = field(
+        default_factory=dict
+    )
+    #: method -> locks certainly held at EVERY call site (fixpoint)
+    entry_locks: Dict[str, frozenset] = field(default_factory=dict)
+    spawns_threads: bool = False
+
+    @property
+    def qual(self) -> Tuple[str, str]:
+        return (self.module.path, self.name)
+
+
+@dataclass
+class FuncInfo:
+    qualname: str  # "Cls.meth" / "func" / "outer.inner"
+    node: ast.AST
+    module: "ModuleInfo"
+    params: List[str]
+    cls: Optional[ClassInfo] = None
+
+    @property
+    def key(self) -> Tuple[str, str]:
+        return (self.module.path, self.qualname)
+
+
+@dataclass
+class JitEntry:
+    """One jit-wrapped callable the project defines (R10/R11 registry)."""
+
+    name: str  # binding name within its defining scope
+    module: "ModuleInfo"
+    params: Optional[List[str]]  # wrapped callable's params, if resolvable
+    donate_names: Set[str] = field(default_factory=set)
+    donate_nums: Set[int] = field(default_factory=set)
+    static_names: Set[str] = field(default_factory=set)
+    static_nums: Set[int] = field(default_factory=set)
+
+    def donated_positions(self) -> Set[int]:
+        out = set(self.donate_nums)
+        if self.params:
+            out |= {
+                i for i, p in enumerate(self.params) if p in self.donate_names
+            }
+        return out
+
+    def static_params(self) -> Set[str]:
+        out = set(self.static_names)
+        if self.params:
+            out |= {
+                p for i, p in enumerate(self.params) if i in self.static_nums
+            }
+        return out
+
+    @property
+    def donating(self) -> bool:
+        return bool(self.donate_names or self.donate_nums)
+
+    @property
+    def has_statics(self) -> bool:
+        return bool(self.static_names or self.static_nums)
+
+
+@dataclass
+class ModuleInfo:
+    path: str  # repo-relative posix path
+    dotted: str  # dotted module name relative to the lint root
+    source: str
+    tree: ast.Module
+    directives: _Directives
+    #: alias -> dotted module name ("canon" -> "pkg.serve.canonical")
+    module_aliases: Dict[str, str] = field(default_factory=dict)
+    #: local name -> (dotted module, symbol) for from-imports
+    symbol_imports: Dict[str, Tuple[str, str]] = field(default_factory=dict)
+    classes: Dict[str, ClassInfo] = field(default_factory=dict)
+    functions: Dict[str, FuncInfo] = field(default_factory=dict)
+    #: module-level NAME = "string constant"
+    str_consts: Dict[str, str] = field(default_factory=dict)
+    #: module-level NAME = ClassName(...) instance types
+    global_types: Dict[str, Tuple[str, str]] = field(default_factory=dict)
+    #: jit entries by binding name (module scope and function-local)
+    jit_entries: Dict[str, JitEntry] = field(default_factory=dict)
+    traced_callees: Set[str] = field(default_factory=set)
+
+
+# -- project construction -----------------------------------------------------
+
+
+def _module_dotted(path: str) -> str:
+    parts = path[:-3].split("/") if path.endswith(".py") else path.split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def _resolve_relative(mod: ModuleInfo, level: int, name: str) -> str:
+    """``from ..x import y`` inside ``mod`` -> dotted target module."""
+    base = mod.dotted.split(".")
+    if not mod.path.endswith("__init__.py"):
+        base = base[:-1]  # the module's package
+    if level > 1:
+        base = base[: len(base) - (level - 1)]
+    return ".".join([p for p in base if p] + ([name] if name else []))
+
+
+class Project:
+    """The whole lint surface parsed once; modules keyed by repo-relative
+    path AND by dotted name (for import resolution)."""
+
+    def __init__(self) -> None:
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.by_dotted: Dict[str, ModuleInfo] = {}
+        #: (module_path, qualname) -> FuncInfo
+        self.functions: Dict[Tuple[str, str], FuncInfo] = {}
+        #: function key -> set of callee function keys
+        self.call_edges: Dict[Tuple[str, str], Set[Tuple[str, str]]] = {}
+        #: thread entry points (function keys)
+        self.thread_roots: Set[Tuple[str, str]] = set()
+        self.reachable: Set[Tuple[str, str]] = set()
+
+    # -- loading -------------------------------------------------------------
+
+    def add_module(self, path: str, source: str) -> Optional[ModuleInfo]:
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError:
+            return None
+        mod = ModuleInfo(
+            path=path,
+            dotted=_module_dotted(path),
+            source=source,
+            tree=tree,
+            directives=_Directives(source),
+        )
+        mod.traced_callees = _traced_callee_names(tree)
+        self.modules[path] = mod
+        self.by_dotted[mod.dotted] = mod
+        return mod
+
+    def finalize(self) -> None:
+        for mod in self.modules.values():
+            self._scan_imports(mod)
+            self._scan_toplevel(mod)
+        for mod in self.modules.values():
+            self._index_functions(mod)
+        for mod in self.modules.values():
+            self._scan_jit_entries(mod)
+        for mod in self.modules.values():
+            for cls in mod.classes.values():
+                _ClassScanner(self, cls).scan()
+        for mod in self.modules.values():
+            self._scan_calls(mod)
+        self._compute_entry_locks()
+        self._compute_reachability()
+
+    # -- imports / module-level bindings -------------------------------------
+
+    def _scan_imports(self, mod: ModuleInfo) -> None:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        mod.module_aliases[a.asname] = a.name
+                    else:
+                        # `import a.b.c` binds the ROOT package `a`
+                        root = a.name.split(".")[0]
+                        mod.module_aliases[root] = root
+            elif isinstance(node, ast.ImportFrom):
+                target = _resolve_relative(
+                    mod, node.level, node.module or ""
+                ) if node.level else (node.module or "")
+                for a in node.names:
+                    local = a.asname or a.name
+                    # `from pkg import sub` can bind a MODULE
+                    sub = f"{target}.{a.name}" if target else a.name
+                    mod.symbol_imports[local] = (target, a.name)
+                    mod.module_aliases.setdefault(local, sub)
+
+    def _scan_toplevel(self, mod: ModuleInfo) -> None:
+        for node in mod.tree.body:
+            if isinstance(node, ast.ClassDef):
+                mod.classes[node.name] = ClassInfo(node.name, mod, node)
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt = node.targets[0]
+                if not isinstance(tgt, ast.Name):
+                    continue
+                if isinstance(node.value, ast.Constant) and isinstance(
+                    node.value.value, str
+                ):
+                    mod.str_consts[tgt.id] = node.value.value
+                elif isinstance(node.value, ast.Call):
+                    cls = self._resolve_class_name(
+                        mod, _dotted(node.value.func)
+                    )
+                    if cls is not None:
+                        mod.global_types[tgt.id] = cls
+
+    def _index_functions(self, mod: ModuleInfo) -> None:
+        def walk(node: ast.AST, prefix: str, cls: Optional[ClassInfo]):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    q = f"{prefix}.{child.name}" if prefix else child.name
+                    params = [
+                        a.arg
+                        for a in (
+                            list(child.args.posonlyargs)
+                            + list(child.args.args)
+                        )
+                    ]
+                    owner = cls if prefix and cls and prefix == cls.name else (
+                        cls if cls and not prefix else None
+                    )
+                    fi = FuncInfo(q, child, mod, params, owner)
+                    mod.functions[q] = fi
+                    self.functions[fi.key] = fi
+                    if cls is not None and prefix == cls.name:
+                        cls.methods[child.name] = child
+                        for dec in child.decorator_list:
+                            if _dotted(dec) == "property":
+                                cls.properties.add(child.name)
+                    walk(child, q, cls)
+                elif isinstance(child, ast.ClassDef):
+                    c = mod.classes.get(child.name)
+                    walk(child, child.name if c else prefix, c or cls)
+                else:
+                    walk(child, prefix, cls)
+
+        walk(mod.tree, "", None)
+
+    # -- name resolution ------------------------------------------------------
+
+    def _resolve_class_name(
+        self, mod: ModuleInfo, dotted: Optional[str]
+    ) -> Optional[Tuple[str, str]]:
+        """Resolve a constructor-call name to a (module_path, class) in
+        this project, through from-imports and module aliases."""
+        if not dotted:
+            return None
+        head, _, rest = dotted.partition(".")
+        if not rest:
+            if head in mod.classes:
+                return (mod.path, head)
+            imp = mod.symbol_imports.get(head)
+            if imp is not None:
+                target = self.by_dotted.get(imp[0])
+                if target is not None and imp[1] in target.classes:
+                    return (target.path, imp[1])
+            return None
+        # mod_alias.ClassName
+        target_name = mod.module_aliases.get(head)
+        if target_name is not None and "." not in rest:
+            target = self.by_dotted.get(target_name)
+            if target is not None and rest in target.classes:
+                return (target.path, rest)
+        return None
+
+    def _resolve_function(
+        self, mod: ModuleInfo, dotted: Optional[str]
+    ) -> Optional[Tuple[str, str]]:
+        """Resolve a call name to a project function key (module functions
+        and imported symbols; not methods)."""
+        if not dotted:
+            return None
+        head, _, rest = dotted.partition(".")
+        if not rest:
+            if head in mod.functions:
+                return (mod.path, head)
+            imp = mod.symbol_imports.get(head)
+            if imp is not None:
+                target = self.by_dotted.get(imp[0])
+                if target is not None and imp[1] in target.functions:
+                    return (target.path, imp[1])
+            return None
+        target_name = mod.module_aliases.get(head)
+        if target_name is not None and "." not in rest:
+            target = self.by_dotted.get(target_name)
+            if target is not None and rest in target.functions:
+                return (target.path, rest)
+        return None
+
+    def _resolve_jit_entry(
+        self, mod: ModuleInfo, fn: Optional[FuncInfo], dotted: Optional[str]
+    ) -> Optional[JitEntry]:
+        if not dotted:
+            return None
+        head, _, rest = dotted.partition(".")
+        if not rest:
+            if head in mod.jit_entries:
+                return mod.jit_entries[head]
+            imp = mod.symbol_imports.get(head)
+            if imp is not None:
+                target = self.by_dotted.get(imp[0])
+                if target is not None:
+                    return target.jit_entries.get(imp[1])
+            return None
+        target_name = mod.module_aliases.get(head)
+        if target_name is not None and "." not in rest:
+            target = self.by_dotted.get(target_name)
+            if target is not None:
+                return target.jit_entries.get(rest)
+        return None
+
+    def resolve_str(self, mod: ModuleInfo, node: ast.AST) -> Optional[str]:
+        """Resolve an axis-name expression to a string constant, through
+        module-level constants and cross-module from-imports."""
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        name = _dotted(node)
+        if not name:
+            return None
+        head, _, rest = name.partition(".")
+        if not rest:
+            if head in mod.str_consts:
+                return mod.str_consts[head]
+            imp = mod.symbol_imports.get(head)
+            if imp is not None:
+                target = self.by_dotted.get(imp[0])
+                if target is not None:
+                    return target.str_consts.get(imp[1])
+            return None
+        target_name = mod.module_aliases.get(head)
+        if target_name is not None and "." not in rest:
+            target = self.by_dotted.get(target_name)
+            if target is not None:
+                return target.str_consts.get(rest)
+        return None
+
+    # -- jit-entry registry (R10/R11) ----------------------------------------
+
+    def _scan_jit_entries(self, mod: ModuleInfo) -> None:
+        def params_of(fn_node) -> List[str]:
+            return [
+                a.arg
+                for a in (
+                    list(fn_node.args.posonlyargs) + list(fn_node.args.args)
+                )
+            ]
+
+        def entry_from_kws(name, params, kws) -> Optional[JitEntry]:
+            e = JitEntry(name=name, module=mod, params=params)
+            for kw in kws:
+                if kw.arg in ("donate_argnames", "static_argnames"):
+                    vals = self._const_str_tuple(mod, kw.value)
+                    if vals is None:
+                        continue
+                    (
+                        e.donate_names
+                        if kw.arg == "donate_argnames"
+                        else e.static_names
+                    ).update(vals)
+                elif kw.arg in ("donate_argnums", "static_argnums"):
+                    nums = _const_int_tuple(kw.value)
+                    if nums is None:
+                        continue
+                    (
+                        e.donate_nums
+                        if kw.arg == "donate_argnums"
+                        else e.static_nums
+                    ).update(nums)
+            return e if (e.donating or e.has_statics) else None
+
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    is_jit, kws = _jit_call_parts(dec)
+                    if not is_jit:
+                        continue
+                    e = entry_from_kws(node.name, params_of(node), kws)
+                    if e is not None:
+                        mod.jit_entries[node.name] = e
+                    break
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt = node.targets[0]
+                if not isinstance(tgt, ast.Name):
+                    continue
+                val = node.value
+                kws: list = []
+                wrapped: Optional[ast.AST] = None
+                if isinstance(val, ast.Call):
+                    is_jit, jkws = _jit_call_parts(val.func)
+                    if is_jit and val.args:
+                        # partial(jax.jit, ...)(f): kwargs live on the
+                        # partial; jax.jit(f, ...): kwargs on the call
+                        kws = list(jkws) + list(val.keywords)
+                        wrapped = val.args[0]
+                    else:
+                        is_jit2, jkws2 = _jit_call_parts(val)
+                        if is_jit2:
+                            # bare partial(jax.jit, ...) binding (rare)
+                            kws = list(jkws2) + list(val.keywords)
+                if wrapped is None and not kws:
+                    continue
+                params = None
+                if isinstance(wrapped, ast.Lambda):
+                    params = [a.arg for a in wrapped.args.args]
+                elif isinstance(wrapped, ast.Name):
+                    fi = mod.functions.get(wrapped.id)
+                    if fi is None:
+                        # nested scope: match by bare name
+                        for q, f in mod.functions.items():
+                            if q.rsplit(".", 1)[-1] == wrapped.id:
+                                fi = f
+                                break
+                    if fi is not None:
+                        params = fi.params
+                e = entry_from_kws(tgt.id, params, kws)
+                if e is not None:
+                    mod.jit_entries[tgt.id] = e
+
+    def _const_str_tuple(
+        self, mod: ModuleInfo, node: ast.AST
+    ) -> Optional[List[str]]:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return [node.value]
+        if isinstance(node, (ast.Tuple, ast.List)):
+            out = []
+            for el in node.elts:
+                if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                    out.append(el.value)
+                else:
+                    return None
+            return out
+        if isinstance(node, ast.Name):
+            # module-level NAME = ("a", "b", ...) constant tuples
+            for stmt in mod.tree.body:
+                if (
+                    isinstance(stmt, ast.Assign)
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and stmt.targets[0].id == node.id
+                ):
+                    return self._const_str_tuple(mod, stmt.value)
+        return None
+
+    # -- call graph / threads -------------------------------------------------
+
+    def _scan_calls(self, mod: ModuleInfo) -> None:
+        for fi in mod.functions.values():
+            edges: Set[Tuple[str, str]] = set()
+            # closures see enclosing scopes' typed locals: merge outer
+            # functions' types (inner bindings shadow outer ones)
+            local_types: Dict[str, Tuple[str, str]] = {}
+            parts = fi.qualname.split(".")
+            for i in range(1, len(parts) + 1):
+                outer = mod.functions.get(".".join(parts[:i]))
+                if outer is not None:
+                    local_types.update(
+                        _local_var_types(self, mod, outer.node)
+                    )
+            for sub in ast.walk(fi.node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                self._edge_for_call(mod, fi, sub, local_types, edges)
+                self._maybe_thread_root(mod, fi, sub, local_types)
+            self.call_edges[fi.key] = edges
+
+    def global_instance_type(
+        self, mod: ModuleInfo, base: ast.AST
+    ) -> Optional[Tuple[str, str]]:
+        """Type of a module-global instance expression: ``NAME`` (local or
+        from-imported) or ``mod_alias.NAME``."""
+        if isinstance(base, ast.Name):
+            t = mod.global_types.get(base.id)
+            if t is not None:
+                return t
+            imp = mod.symbol_imports.get(base.id)
+            if imp is not None:
+                target = self.by_dotted.get(imp[0])
+                if target is not None:
+                    return target.global_types.get(imp[1])
+            return None
+        if (
+            isinstance(base, ast.Attribute)
+            and isinstance(base.value, ast.Name)
+        ):
+            target_name = mod.module_aliases.get(base.value.id)
+            if target_name is not None:
+                target = self.by_dotted.get(target_name)
+                if target is not None:
+                    return target.global_types.get(base.attr)
+        return None
+
+    def _method_key(
+        self, cls_key: Tuple[str, str], meth: str
+    ) -> Optional[Tuple[str, str]]:
+        mod = self.modules.get(cls_key[0])
+        if mod is None:
+            return None
+        cls = mod.classes.get(cls_key[1])
+        if cls is None or meth not in cls.methods:
+            return None
+        return (mod.path, f"{cls.name}.{meth}")
+
+    def _edge_for_call(self, mod, fi, call, local_types, edges) -> None:
+        func = call.func
+        if isinstance(func, ast.Attribute):
+            base = func.value
+            meth = func.attr
+            # self.m(...)
+            if isinstance(base, ast.Name) and base.id == "self" and fi.cls:
+                key = self._method_key(fi.cls.qual, meth)
+                if key:
+                    edges.add(key)
+                return
+            # self.field.m(...)
+            if (
+                isinstance(base, ast.Attribute)
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "self"
+                and fi.cls is not None
+            ):
+                ftype = fi.cls.field_types.get(base.attr)
+                if ftype:
+                    key = self._method_key(ftype, meth)
+                    if key:
+                        edges.add(key)
+                return
+            # var.m(...) with a known local type
+            if isinstance(base, ast.Name):
+                vtype = local_types.get(base.id)
+                if vtype:
+                    key = self._method_key(vtype, meth)
+                    if key:
+                        edges.add(key)
+                    return
+            # GLOBAL.m(...) / mod_alias.GLOBAL.m(...) on a typed
+            # module-level instance (the TRACER/REGISTRY singletons)
+            gtype = self.global_instance_type(mod, base)
+            if gtype:
+                key = self._method_key(gtype, meth)
+                if key:
+                    edges.add(key)
+                return
+            # mod_alias.f(...)
+            key = self._resolve_function(mod, _dotted(func))
+            if key:
+                edges.add(key)
+            return
+        if isinstance(func, ast.Name):
+            # nested defs resolve innermost-scope-first (closure calls)
+            key = self._nested_or_module_function(mod, fi, func.id)
+            if key:
+                edges.add(key)
+                return
+        name = _dotted(func)
+        # constructor call -> __init__ edge
+        cls_key = self._resolve_class_name(mod, name)
+        if cls_key:
+            key = self._method_key(cls_key, "__init__")
+            if key:
+                edges.add(key)
+
+    def _nested_or_module_function(
+        self, mod: ModuleInfo, fi: FuncInfo, name: str
+    ) -> Optional[Tuple[str, str]]:
+        prefix = fi.qualname
+        while True:
+            q = f"{prefix}.{name}" if prefix else name
+            if q in mod.functions:
+                return (mod.path, q)
+            if not prefix:
+                break
+            prefix = prefix.rsplit(".", 1)[0] if "." in prefix else ""
+        return self._resolve_function(mod, name)
+
+    def _fn_ref_key(
+        self, mod, fi, node, local_types
+    ) -> Optional[Tuple[str, str]]:
+        """Resolve a function REFERENCE expression (not a call) to a
+        project function key — thread targets and executor submissions."""
+        if isinstance(node, ast.Attribute):
+            base = node.value
+            if isinstance(base, ast.Name) and base.id == "self" and fi.cls:
+                return self._method_key(fi.cls.qual, node.attr)
+            if isinstance(base, ast.Name):
+                vtype = local_types.get(base.id) or mod.global_types.get(
+                    base.id
+                )
+                if vtype:
+                    return self._method_key(vtype, node.attr)
+            return self._resolve_function(mod, _dotted(node))
+        if isinstance(node, ast.Name):
+            # nested def in the same enclosing scope first
+            prefix = fi.qualname
+            while True:
+                q = f"{prefix}.{node.id}" if prefix else node.id
+                if q in mod.functions:
+                    return (mod.path, q)
+                if not prefix:
+                    break
+                prefix = prefix.rsplit(".", 1)[0] if "." in prefix else ""
+            return self._resolve_function(mod, node.id)
+        return None
+
+    def _maybe_thread_root(self, mod, fi, call, local_types) -> None:
+        name = _dotted(call.func) or ""
+        is_thread = name.rsplit(".", 1)[-1] == "Thread"
+        is_submit = (
+            isinstance(call.func, ast.Attribute)
+            and call.func.attr == "submit"
+            and self._executor_receiver(mod, call.func.value, local_types)
+        )
+        target_expr = None
+        if is_thread:
+            for kw in call.keywords:
+                if kw.arg == "target":
+                    target_expr = kw.value
+        elif is_submit and call.args:
+            target_expr = call.args[0]
+        else:
+            return
+        if target_expr is None:
+            return
+        key = self._fn_ref_key(mod, fi, target_expr, local_types)
+        if key is not None:
+            self.thread_roots.add(key)
+            if is_thread and fi.cls is not None:
+                fi.cls.spawns_threads = True
+
+    def _executor_receiver(self, mod, recv, local_types) -> bool:
+        """Is ``.submit``'s receiver plausibly a thread-pool executor?
+        A project class's own ``submit`` (the micro-batch scheduler's
+        takes DATA) must not turn its first argument into a phantom
+        thread root, so: never a project-typed receiver, and the
+        receiver's name must say executor/pool (stdlib executors are
+        invisible to the index, names are the only signal left)."""
+        if isinstance(recv, ast.Name):
+            rtype = local_types.get(recv.id) or mod.global_types.get(recv.id)
+            if rtype is not None:
+                return False  # a project class: its submit takes work items
+        path = (_attr_path(recv) or "").rsplit(".", 1)[-1].lower()
+        return "pool" in path or "executor" in path or path == "ex"
+
+    # -- fixpoints ------------------------------------------------------------
+
+    def _compute_entry_locks(self) -> None:
+        """A method only ever called with lock L held effectively holds L
+        for its whole body (``*_locked`` helpers). Intersection over call
+        sites, iterated to fixpoint."""
+        for mod in self.modules.values():
+            for cls in mod.classes.values():
+                entry = {m: None for m in cls.methods}  # None = no info yet
+                for _ in range(len(cls.methods) + 1):
+                    changed = False
+                    incoming: Dict[str, Optional[frozenset]] = {
+                        m: None for m in cls.methods
+                    }
+                    for caller, calls in cls.intra_calls.items():
+                        caller_entry = entry.get(caller) or frozenset()
+                        for callee, held in calls:
+                            eff = frozenset(held) | caller_entry
+                            cur = incoming.get(callee)
+                            incoming[callee] = (
+                                eff if cur is None else (cur & eff)
+                            )
+                    for m in cls.methods:
+                        new = incoming[m] or frozenset()
+                        if entry[m] != new:
+                            entry[m] = new
+                            changed = True
+                    if not changed:
+                        break
+                cls.entry_locks = {
+                    m: (v or frozenset()) for m, v in entry.items()
+                }
+
+    def _compute_reachability(self) -> None:
+        seen = set(self.thread_roots)
+        frontier = list(seen)
+        while frontier:
+            key = frontier.pop()
+            for nxt in self.call_edges.get(key, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    frontier.append(nxt)
+        self.reachable = seen
+
+    def concurrent_classes(self) -> Set[Tuple[str, str]]:
+        out: Set[Tuple[str, str]] = set()
+        for mod in self.modules.values():
+            for cls in mod.classes.values():
+                if cls.spawns_threads:
+                    out.add(cls.qual)
+                    continue
+                for m in cls.methods:
+                    if (mod.path, f"{cls.name}.{m}") in self.reachable:
+                        out.add(cls.qual)
+                        break
+        return out
+
+
+def _const_int_tuple(node: ast.AST) -> Optional[List[int]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, int):
+                out.append(el.value)
+            else:
+                return None
+        return out
+    return None
+
+
+def _local_var_types(
+    project: Project, mod: ModuleInfo, fn_node: ast.AST
+) -> Dict[str, Tuple[str, str]]:
+    """name -> class for locals assigned ``ClassName(...)`` (also through
+    ``a or ClassName(...)``) and parameters annotated with a project class
+    (``Optional[X]`` unwrapped)."""
+    out: Dict[str, Tuple[str, str]] = {}
+
+    def class_of_expr(expr) -> Optional[Tuple[str, str]]:
+        if isinstance(expr, ast.Call):
+            return project._resolve_class_name(mod, _dotted(expr.func))
+        if isinstance(expr, ast.BoolOp):
+            for v in expr.values:
+                c = class_of_expr(v)
+                if c is not None:
+                    return c
+        return None
+
+    if isinstance(fn_node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        for a in list(fn_node.args.posonlyargs) + list(fn_node.args.args):
+            ann = a.annotation
+            if isinstance(ann, ast.Subscript):  # Optional[X] / Dict[...]
+                ann = ann.slice
+            c = project._resolve_class_name(mod, _dotted(ann)) if ann else None
+            if c is not None:
+                out[a.arg] = c
+    for sub in ast.walk(fn_node):
+        if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+            tgt = sub.targets[0]
+            if isinstance(tgt, ast.Name):
+                c = class_of_expr(sub.value)
+                if c is not None:
+                    out[tgt.id] = c
+    return out
+
+
+# -- class scanning (R9 IR) ----------------------------------------------------
+
+
+class _ClassScanner:
+    """Collects a class's lock attrs, field types, and every ``self.*``
+    access with the lexically-held lock set."""
+
+    def __init__(self, project: Project, cls: ClassInfo):
+        self.project = project
+        self.cls = cls
+        self.mod = cls.module
+
+    def scan(self) -> None:
+        # pass 1: lock attrs + field types (any method may declare them)
+        for name, meth in self.cls.methods.items():
+            for sub in ast.walk(meth):
+                if not isinstance(sub, ast.Assign):
+                    continue
+                for tgt in sub.targets:
+                    if not (
+                        isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"
+                    ):
+                        continue
+                    val = sub.value
+                    if isinstance(val, ast.Call):
+                        callee = _dotted(val.func) or ""
+                        if callee.rsplit(".", 1)[-1] in _LOCK_FACTORIES:
+                            self.cls.lock_attrs.add(tgt.attr)
+                            continue
+                        ftype = self.project._resolve_class_name(
+                            self.mod, _dotted(val.func)
+                        )
+                        if ftype is not None:
+                            self.cls.field_types[tgt.attr] = ftype
+                    elif isinstance(val, ast.Name):
+                        # self.x = param — use the param's annotation
+                        types = _local_var_types(self.project, self.mod, meth)
+                        ftype = types.get(val.id)
+                        if ftype is not None:
+                            self.cls.field_types[tgt.attr] = ftype
+        # pass 2: accesses + intra-class calls, per method
+        for name, meth in self.cls.methods.items():
+            self.cls.intra_calls.setdefault(name, [])
+            self._walk_stmt_list(meth.body, name, frozenset())
+
+    # recursive statement walker tracking `with self.<lock>:` scopes
+    def _walk_stmt_list(self, stmts, method: str, held: frozenset) -> None:
+        for stmt in stmts:
+            self._walk_stmt(stmt, method, held)
+
+    def _walk_stmt(self, stmt, method: str, held: frozenset) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def: runs later, the lexical lock is NOT guaranteed
+            self._walk_stmt_list(stmt.body, method, frozenset())
+            return
+        if isinstance(stmt, ast.With):
+            acquired = set()
+            for item in stmt.items:
+                self._visit_expr(item.context_expr, method, held)
+                ce = item.context_expr
+                if (
+                    isinstance(ce, ast.Attribute)
+                    and isinstance(ce.value, ast.Name)
+                    and ce.value.id == "self"
+                    and ce.attr in self.cls.lock_attrs
+                ):
+                    acquired.add(ce.attr)
+            self._walk_stmt_list(stmt.body, method, held | acquired)
+            return
+        # visit this statement's own expressions, then child statements
+        for fname, value in ast.iter_fields(stmt):
+            if isinstance(value, list):
+                if value and isinstance(value[0], ast.stmt):
+                    self._walk_stmt_list(value, method, held)
+                else:
+                    for v in value:
+                        if isinstance(v, ast.expr):
+                            self._visit_expr(v, method, held)
+                        elif isinstance(v, ast.excepthandler):
+                            self._walk_stmt_list(v.body, method, held)
+            elif isinstance(value, ast.expr):
+                self._visit_expr(value, method, held)
+
+    def _visit_expr(self, expr, method: str, held: frozenset) -> None:
+        writeish = _writeish_attr_ids(expr)
+        for sub in ast.walk(expr):
+            if isinstance(sub, (ast.Lambda,)):
+                continue
+            if isinstance(sub, ast.Call):
+                self._record_call(sub, method, held)
+            elif isinstance(sub, ast.Attribute):
+                self._record_attr(sub, method, held, writeish)
+
+    def _record_call(self, call: ast.Call, method: str, held) -> None:
+        func = call.func
+        if (
+            isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "self"
+            and func.attr in self.cls.methods
+        ):
+            self.cls.intra_calls.setdefault(method, []).append(
+                (func.attr, held)
+            )
+
+    def _record_attr(
+        self, node: ast.Attribute, method: str, held, writeish
+    ) -> None:
+        base = node.value
+        write = isinstance(node.ctx, (ast.Store, ast.Del)) or (
+            id(node) in writeish
+        )
+        # self.attr
+        if isinstance(base, ast.Name) and base.id == "self":
+            if node.attr in self.cls.lock_attrs:
+                return
+            self.cls.accesses.append(
+                Access(
+                    attr=node.attr,
+                    write=write,
+                    line=node.lineno,
+                    method=method,
+                    held=held,
+                    node=node,
+                )
+            )
+            return
+        # self.field.attr (cross-object)
+        if (
+            isinstance(base, ast.Attribute)
+            and isinstance(base.value, ast.Name)
+            and base.value.id == "self"
+        ):
+            self.cls.accesses.append(
+                Access(
+                    attr=node.attr,
+                    write=write,
+                    line=node.lineno,
+                    method=method,
+                    held=held,
+                    node=node,
+                    via_field=base.attr,
+                )
+            )
+
+
+def _enclosing_scope(mod: ModuleInfo, node: ast.AST) -> str:
+    """Qualified name of the innermost indexed def whose span contains
+    ``node`` (baseline-compatible), or "<module>"."""
+    line = getattr(node, "lineno", 0)
+    best: Optional[FuncInfo] = None
+    for fi in mod.functions.values():
+        start = fi.node.lineno
+        end = getattr(fi.node, "end_lineno", start)
+        if start <= line <= end:
+            if best is None or start > best.node.lineno:
+                best = fi
+    return best.qualname if best is not None else "<module>"
+
+
+def _writeish_attr_ids(expr: ast.AST) -> Set[int]:
+    """ids of Attribute nodes mutated THROUGH: ``self.d[k] = v`` stores
+    into the object ``self.d`` names, and ``self.q.append(x)`` mutates
+    ``self.q`` — both count as writes for lock-guard inference."""
+    out: Set[int] = set()
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Subscript) and isinstance(
+            sub.ctx, (ast.Store, ast.Del)
+        ):
+            if isinstance(sub.value, ast.Attribute):
+                out.add(id(sub.value))
+        elif (
+            isinstance(sub, ast.Call)
+            and isinstance(sub.func, ast.Attribute)
+            and sub.func.attr in _MUTATORS
+            and isinstance(sub.func.value, ast.Attribute)
+        ):
+            out.add(id(sub.func.value))
+    return out
+
+
+# -- the linter ----------------------------------------------------------------
+
+
+class FlowLinter:
+    def __init__(self, project: Project, rules: Set[str]):
+        self.project = project
+        self.rules = rules
+        self.violations: List[Violation] = []
+        self._seen: Set[Tuple[str, int, str, str]] = set()
+
+    # -- emission -------------------------------------------------------------
+
+    def _emit(
+        self,
+        mod: ModuleInfo,
+        node: ast.AST,
+        rule: str,
+        scope: str,
+        message: str,
+        def_line: Optional[int] = None,
+    ) -> None:
+        if rule not in self.rules:
+            return
+        line = getattr(node, "lineno", 1)
+        key = (mod.path, line, rule, message)
+        if key in self._seen:
+            return
+        if mod.directives.suppressed(line, rule, def_line):
+            return
+        lines = mod.source.splitlines()
+        code = lines[line - 1].strip() if 0 < line <= len(lines) else ""
+        self._seen.add(key)
+        self.violations.append(
+            Violation(mod.path, line, rule, scope, code, message)
+        )
+
+    def _def_line_of(self, mod: ModuleInfo, scope: str) -> Optional[int]:
+        fi = mod.functions.get(scope)
+        return fi.node.lineno if fi is not None else None
+
+    # -- R9 -------------------------------------------------------------------
+
+    def check_r9(self) -> None:
+        concurrent = self.project.concurrent_classes()
+        guarded: Dict[Tuple[str, str], Dict[str, Set[str]]] = {}
+        # inference pass: locked WRITES outside __init__ define guards
+        for mod in self.project.modules.values():
+            for cls in mod.classes.values():
+                gmap: Dict[str, Set[str]] = {}
+                for acc in cls.accesses:
+                    if acc.via_field is not None or acc.method == "__init__":
+                        continue
+                    eff = acc.held | cls.entry_locks.get(
+                        acc.method, frozenset()
+                    )
+                    if acc.write and eff:
+                        gmap.setdefault(acc.attr, set()).update(eff)
+                guarded[cls.qual] = gmap
+        # double-checked-locking suppression: an unlocked read whose
+        # method (or a direct intra-class callee — the faults registry's
+        # ``fire`` -> ``_cross`` fast path) re-reads the attribute under
+        # the guarding lock is the sanctioned lock-free pre-check
+        for mod in self.project.modules.values():
+            for cls in mod.classes.values():
+                if cls.qual not in concurrent:
+                    continue
+                gmap = guarded[cls.qual]
+                if not gmap:
+                    continue
+                locked_by_method: Dict[str, Set[str]] = {}
+                for acc in cls.accesses:
+                    if acc.via_field is None and not acc.write:
+                        eff = acc.held | cls.entry_locks.get(
+                            acc.method, frozenset()
+                        )
+                        if acc.attr in gmap and eff & gmap[acc.attr]:
+                            locked_by_method.setdefault(
+                                acc.method, set()
+                            ).add(acc.attr)
+                locked_reads: Set[Tuple[str, str]] = set()
+                for m in cls.methods:
+                    attrs = set(locked_by_method.get(m, ()))
+                    for callee, _held in cls.intra_calls.get(m, ()):
+                        attrs |= locked_by_method.get(callee, set())
+                    for a in attrs:
+                        locked_reads.add((m, a))
+                for acc in cls.accesses:
+                    if acc.via_field is not None or acc.method == "__init__":
+                        continue
+                    locks = gmap.get(acc.attr)
+                    if not locks:
+                        continue
+                    eff = acc.held | cls.entry_locks.get(
+                        acc.method, frozenset()
+                    )
+                    if eff & locks:
+                        continue
+                    if (
+                        not acc.write
+                        and (acc.method, acc.attr) in locked_reads
+                    ):
+                        continue  # double-checked locking idiom
+                    scope = f"{cls.name}.{acc.method}"
+                    lock_names = ", ".join(sorted(f"self.{n}" for n in locks))
+                    verb = "write to" if acc.write else "read of"
+                    self._emit(
+                        mod,
+                        acc.node,
+                        "R9",
+                        scope,
+                        f"{verb} `self.{acc.attr}` without {lock_names} — "
+                        f"every other mutation of this attribute holds the "
+                        f"lock, and {cls.name} runs on multiple threads "
+                        "(data race: lost updates / torn reads)",
+                        def_line=self._def_line_of(mod, scope),
+                    )
+        # cross-object pass: self.field.attr where field's class guards attr
+        for mod in self.project.modules.values():
+            for cls in mod.classes.values():
+                for acc in cls.accesses:
+                    if acc.via_field is None:
+                        continue
+                    ftype = cls.field_types.get(acc.via_field)
+                    if ftype is None or ftype not in concurrent:
+                        continue
+                    fmod = self.project.modules.get(ftype[0])
+                    fcls = fmod.classes.get(ftype[1]) if fmod else None
+                    if fcls is None:
+                        continue
+                    if acc.attr in fcls.methods or acc.attr in fcls.properties:
+                        continue
+                    locks = guarded.get(ftype, {}).get(acc.attr)
+                    if not locks:
+                        continue
+                    # only contexts that can run concurrently with the
+                    # target object's threads are flagged
+                    accessor_key = (mod.path, f"{cls.name}.{acc.method}")
+                    if (
+                        cls.qual not in concurrent
+                        and accessor_key not in self.project.reachable
+                    ):
+                        continue
+                    scope = f"{cls.name}.{acc.method}"
+                    lock_names = ", ".join(sorted(locks))
+                    self._emit(
+                        mod,
+                        acc.node,
+                        "R9",
+                        scope,
+                        f"unlocked {'write to' if acc.write else 'read of'} "
+                        f"`self.{acc.via_field}.{acc.attr}`, an attribute "
+                        f"{ftype[1]} guards with `self.{lock_names}` — "
+                        f"take a snapshot through a locked accessor on "
+                        f"{ftype[1]} instead of reaching into its state",
+                        def_line=self._def_line_of(mod, scope),
+                    )
+        self._check_global_instances(concurrent, guarded)
+
+    def _check_global_instances(self, concurrent, guarded) -> None:
+        """Accesses to guarded attributes of module-global instances
+        (``TRACER.path`` through any import alias) from thread-shared
+        contexts."""
+        for mod in self.project.modules.values():
+            for fi in mod.functions.values():
+                in_concurrent_cls = (
+                    fi.cls is not None and fi.cls.qual in concurrent
+                )
+                if fi.key not in self.project.reachable and not in_concurrent_cls:
+                    continue
+                writeish = _writeish_attr_ids(fi.node)
+                for sub in ast.walk(fi.node):
+                    if not isinstance(sub, ast.Attribute):
+                        continue
+                    gtype = self._global_instance_type(mod, sub.value)
+                    if gtype is None or gtype not in concurrent:
+                        continue
+                    gmod = self.project.modules.get(gtype[0])
+                    gcls = gmod.classes.get(gtype[1]) if gmod else None
+                    if gcls is None:
+                        continue
+                    if (
+                        sub.attr in gcls.methods
+                        or sub.attr in gcls.properties
+                    ):
+                        continue
+                    locks = guarded.get(gtype, {}).get(sub.attr)
+                    if not locks:
+                        continue
+                    # accesses inside the owning class itself were already
+                    # judged (with lock context) by the within-class pass
+                    if fi.cls is not None and fi.cls.qual == gtype:
+                        continue
+                    write = isinstance(sub.ctx, (ast.Store, ast.Del)) or (
+                        id(sub) in writeish
+                    )
+                    base = _attr_path(sub.value) or "<global>"
+                    self._emit(
+                        mod,
+                        sub,
+                        "R9",
+                        fi.qualname,
+                        f"unlocked {'write to' if write else 'read of'} "
+                        f"`{base}.{sub.attr}`, an attribute {gtype[1]} "
+                        f"guards with `self.{', '.join(sorted(locks))}` — "
+                        f"go through a locked accessor on {gtype[1]}",
+                        def_line=fi.node.lineno,
+                    )
+
+    def _global_instance_type(
+        self, mod: ModuleInfo, base: ast.AST
+    ) -> Optional[Tuple[str, str]]:
+        return self.project.global_instance_type(mod, base)
+
+    # -- R10 ------------------------------------------------------------------
+
+    def check_r10(self) -> None:
+        for mod in self.project.modules.values():
+            for fi in mod.functions.values():
+                bare = fi.qualname.rsplit(".", 1)[-1]
+                if bare in mod.traced_callees:
+                    continue  # traced bodies: donation is inlined by XLA
+                local_entries = _local_jit_entries(self.project, mod, fi)
+                _DonationScan(self, mod, fi, local_entries).run()
+
+    # -- R11 ------------------------------------------------------------------
+
+    def check_r11(self) -> None:
+        for mod in self.project.modules.values():
+            for fi in mod.functions.values():
+                bare = fi.qualname.rsplit(".", 1)[-1]
+                if bare in mod.traced_callees:
+                    continue
+                local_entries = _local_jit_entries(self.project, mod, fi)
+                self._r11_function(mod, fi, local_entries)
+
+    def _r11_function(self, mod, fi, local_entries) -> None:
+        unhashable_locals: Dict[str, str] = {}
+        for sub in ast.walk(fi.node):
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                tgt = sub.targets[0]
+                if isinstance(tgt, ast.Name):
+                    kind = _unhashable_kind(sub.value)
+                    if kind is not None:
+                        unhashable_locals[tgt.id] = kind
+                    else:
+                        unhashable_locals.pop(tgt.id, None)
+
+        def walk(node, loop_vars: Dict[str, bool]):
+            # loop_vars: name -> True when the loop's iterable is UNBOUNDED
+            if isinstance(node, ast.For):
+                unbounded = not _bounded_iter(node.iter)
+                names = {
+                    n.id: unbounded
+                    for n in ast.walk(node.target)
+                    if isinstance(n, ast.Name)
+                }
+                inner = dict(loop_vars)
+                inner.update(names)
+                for child in ast.iter_child_nodes(node):
+                    walk(child, inner)
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if node is not fi.node:
+                    return  # nested defs get their own visit
+            if isinstance(node, ast.Call):
+                self._r11_call(mod, fi, node, local_entries, loop_vars,
+                               unhashable_locals)
+            for child in ast.iter_child_nodes(node):
+                walk(child, loop_vars)
+
+        walk(fi.node, {})
+
+    def _r11_call(
+        self, mod, fi, call, local_entries, loop_vars, unhashable_locals
+    ) -> None:
+        entry = self._entry_for_call(mod, fi, call, local_entries)
+        if entry is None or not entry.has_statics:
+            return
+        statics = entry.static_params()
+        bindings: List[Tuple[str, ast.AST]] = []
+        if entry.params:
+            for i, arg in enumerate(call.args):
+                if isinstance(arg, ast.Starred):
+                    break
+                if i < len(entry.params):
+                    bindings.append((entry.params[i], arg))
+        else:
+            for i, arg in enumerate(call.args):
+                if i in entry.static_nums:
+                    bindings.append((f"#{i}", arg))
+                    statics.add(f"#{i}")
+        for kw in call.keywords:
+            if kw.arg is not None:
+                bindings.append((kw.arg, kw.value))
+        for pname, expr in bindings:
+            if pname not in statics:
+                continue
+            kind = _unhashable_kind(expr)
+            reason = None
+            if kind is not None:
+                reason = f"an unhashable {kind} (TypeError at dispatch)"
+            elif isinstance(expr, ast.JoinedStr):
+                reason = (
+                    "an f-string — a distinct value per call means one "
+                    "silent XLA recompile per call"
+                )
+            elif isinstance(expr, ast.Name):
+                if expr.id in unhashable_locals:
+                    reason = (
+                        f"`{expr.id}`, bound to an unhashable "
+                        f"{unhashable_locals[expr.id]} above"
+                    )
+                elif loop_vars.get(expr.id):
+                    reason = (
+                        f"loop variable `{expr.id}` of an unbounded loop — "
+                        "one recompile per distinct iterate (bounded "
+                        "literal/range loops are the sanctioned precompile "
+                        "pattern)"
+                    )
+            if reason is not None:
+                self._emit(
+                    mod,
+                    expr,
+                    "R11",
+                    fi.qualname,
+                    f"static arg `{pname}` of jit entry `{entry.name}` is "
+                    f"{reason}; statics key the compile cache — pass "
+                    "hashable, low-cardinality values",
+                    def_line=fi.node.lineno,
+                )
+
+    def _entry_for_call(
+        self, mod, fi, call, local_entries
+    ) -> Optional[JitEntry]:
+        name = _dotted(call.func)
+        if name and name in local_entries:
+            return local_entries[name]
+        return self.project._resolve_jit_entry(mod, fi, name)
+
+    # -- R12 ------------------------------------------------------------------
+
+    def check_r12(self) -> None:
+        for mod in self.project.modules.values():
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = _dotted(node.func) or ""
+                if name.rsplit(".", 1)[-1] != "shard_map":
+                    continue
+                self._r12_site(mod, node)
+
+    def _r12_site(self, mod: ModuleInfo, call: ast.Call) -> None:
+        declared: Set[str] = set()
+        for kw in call.keywords:
+            if kw.arg not in ("in_specs", "out_specs"):
+                continue
+            for sub in ast.walk(kw.value):
+                if isinstance(sub, ast.Call):
+                    cname = (_dotted(sub.func) or "").rsplit(".", 1)[-1]
+                    if cname in _SPEC_NAMES:
+                        for arg in sub.args:
+                            elts = (
+                                arg.elts
+                                if isinstance(arg, (ast.Tuple, ast.List))
+                                else [arg]
+                            )
+                            for el in elts:
+                                s = self.project.resolve_str(mod, el)
+                                if s is not None:
+                                    declared.add(s)
+        if not declared:
+            return  # axis names not statically resolvable: never guess
+        body = call.args[0] if call.args else None
+        body_def = None
+        fi = None
+        if isinstance(body, ast.Lambda):
+            body_def = body
+        elif isinstance(body, ast.Name):
+            fi = mod.functions.get(body.id)
+            if fi is None:
+                for q, f in mod.functions.items():
+                    if q.rsplit(".", 1)[-1] == body.id:
+                        fi = f
+                        break
+            body_def = fi.node if fi is not None else None
+        if body_def is None:
+            return
+        # scope must be a name collect_scopes can re-derive, or the
+        # baseline ratchet would flag an accepted entry as dead debt:
+        # the body def's QUALIFIED name, or (for lambdas) the qualified
+        # enclosing def of the shard_map call itself
+        scope = (
+            fi.qualname if fi is not None else _enclosing_scope(mod, call)
+        )
+        def_line = getattr(body_def, "lineno", None)
+        for sub in ast.walk(body_def):
+            if not isinstance(sub, ast.Call):
+                continue
+            cname = (_dotted(sub.func) or "").rsplit(".", 1)[-1]
+            if cname not in _AXIS_ARG:
+                continue
+            axis_expr = None
+            for kw in sub.keywords:
+                if kw.arg == "axis_name":
+                    axis_expr = kw.value
+            if axis_expr is None:
+                pos = _AXIS_ARG[cname]
+                if pos < len(sub.args):
+                    axis_expr = sub.args[pos]
+            if axis_expr is None:
+                continue
+            elts = (
+                axis_expr.elts
+                if isinstance(axis_expr, (ast.Tuple, ast.List))
+                else [axis_expr]
+            )
+            for el in elts:
+                s = self.project.resolve_str(mod, el)
+                if s is not None and s not in declared:
+                    self._emit(
+                        mod,
+                        sub,
+                        "R12",
+                        scope,
+                        f"{cname}(..., axis_name={s!r}) inside a shard_map "
+                        f"body whose wrapping site declares axes "
+                        f"{sorted(declared)} — the collective would target "
+                        "an axis the mesh program never bound (trace-time "
+                        "error, or the wrong axis after a mesh refactor)",
+                        def_line=def_line,
+                    )
+
+    # -- driver ---------------------------------------------------------------
+
+    def run(self) -> List[Violation]:
+        if "R9" in self.rules:
+            self.check_r9()
+        if "R10" in self.rules:
+            self.check_r10()
+        if "R11" in self.rules:
+            self.check_r11()
+        if "R12" in self.rules:
+            self.check_r12()
+        self.violations.sort(key=lambda v: (v.path, v.line, v.rule))
+        return self.violations
+
+
+def _local_jit_entries(
+    project: Project, mod: ModuleInfo, fi: FuncInfo
+) -> Dict[str, JitEntry]:
+    """jit entries bound to LOCAL names inside ``fi`` (the sharded solver
+    builds its donating ``step``/``step_loop`` callables per-mesh)."""
+    out: Dict[str, JitEntry] = {}
+    for sub in ast.walk(fi.node):
+        if not (isinstance(sub, ast.Assign) and len(sub.targets) == 1):
+            continue
+        tgt = sub.targets[0]
+        if not isinstance(tgt, ast.Name):
+            continue
+        val = sub.value
+        if not isinstance(val, ast.Call):
+            continue
+        is_jit, jkws = _jit_call_parts(val.func)
+        kws = list(jkws) + list(val.keywords)
+        if not is_jit:
+            is_jit, kws2 = _jit_call_parts(val)
+            kws = list(kws2)
+        if not is_jit:
+            continue
+        e = JitEntry(name=tgt.id, module=mod, params=None)
+        for kw in kws:
+            if kw.arg == "donate_argnames":
+                vals = project._const_str_tuple(mod, kw.value)
+                if vals:
+                    e.donate_names.update(vals)
+            elif kw.arg == "donate_argnums":
+                nums = _const_int_tuple(kw.value)
+                if nums:
+                    e.donate_nums.update(nums)
+            elif kw.arg == "static_argnames":
+                vals = project._const_str_tuple(mod, kw.value)
+                if vals:
+                    e.static_names.update(vals)
+            elif kw.arg == "static_argnums":
+                nums = _const_int_tuple(kw.value)
+                if nums:
+                    e.static_nums.update(nums)
+        if val.args and isinstance(val.args[0], ast.Name):
+            wfi = mod.functions.get(val.args[0].id)
+            if wfi is None:
+                for q, f in mod.functions.items():
+                    if q.rsplit(".", 1)[-1] == val.args[0].id:
+                        wfi = f
+                        break
+            if wfi is not None:
+                e.params = wfi.params
+        if e.donating or e.has_statics:
+            out[tgt.id] = e
+    return out
+
+
+def _unhashable_kind(expr: ast.AST) -> Optional[str]:
+    if isinstance(expr, ast.List):
+        return "list display"
+    if isinstance(expr, ast.Dict):
+        return "dict display"
+    if isinstance(expr, ast.Set):
+        return "set display"
+    if isinstance(expr, ast.ListComp):
+        return "list comprehension"
+    if isinstance(expr, ast.SetComp):
+        return "set comprehension"
+    if isinstance(expr, ast.DictComp):
+        return "dict comprehension"
+    if isinstance(expr, ast.Call):
+        name = _dotted(expr.func) or ""
+        root = name.split(".", 1)[0]
+        if root in _ARRAY_ROOTS and name.rsplit(".", 1)[-1] in (
+            "array", "asarray", "zeros", "ones", "arange", "full",
+        ):
+            return f"{name}() array"
+    return None
+
+
+def _bounded_iter(expr: ast.AST) -> bool:
+    """Is a for-loop's iterable a bounded literal (tuple/list/set display,
+    ``range(...)``, or ``enumerate(<bounded>)``)? Loop vars over these are
+    the deliberate warm-every-bucket pattern, not a recompile storm."""
+    if isinstance(expr, (ast.Tuple, ast.List, ast.Set, ast.Constant)):
+        return True
+    if isinstance(expr, ast.Call):
+        name = (_dotted(expr.func) or "").rsplit(".", 1)[-1]
+        if name == "range":
+            return True
+        if name in ("enumerate", "sorted", "reversed", "zip") and expr.args:
+            return all(_bounded_iter(a) for a in expr.args)
+    return False
+
+
+# -- R10 donation scan ---------------------------------------------------------
+
+
+class _DonationScan:
+    """Forward walk of one function's statement graph tracking donated
+    bindings (dotted paths). A use of a donated path that isn't the
+    sanctioned ``check_donated`` call is a violation; rebinding kills."""
+
+    def __init__(self, linter: FlowLinter, mod, fi, local_entries):
+        self.linter = linter
+        self.mod = mod
+        self.fi = fi
+        self.local_entries = local_entries
+        self.emitted: Set[Tuple[int, str]] = set()
+
+    def run(self) -> None:
+        # loops re-run their own bodies against the joined back-edge
+        # state (see _stmt), so one top-level pass suffices
+        self._block(list(self.fi.node.body), {}, emit=True)
+
+    # state: dict donated_path -> (donor entry name, donor line); None
+    # return value = every path through the block terminated
+    def _block(self, stmts, state, emit: bool):
+        cur = dict(state)
+        for stmt in stmts:
+            cur = self._stmt(stmt, cur, emit)
+            if cur is None:
+                return None
+        return cur
+
+    def _join(self, *states):
+        live = [s for s in states if s is not None]
+        if not live:
+            return None
+        out: Dict[str, Tuple[str, int]] = {}
+        for s in live:
+            out.update(s)
+        return out
+
+    def _stmt(self, stmt, state, emit):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return state
+        if isinstance(stmt, ast.Assign):
+            self._expr(stmt.value, state, emit)
+            for tgt in stmt.targets:
+                self._kill_target(tgt, state)
+            return state
+        if isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._expr(stmt.value, state, emit)
+            self._kill_target(stmt.target, state)
+            return state
+        if isinstance(stmt, ast.AugAssign):
+            self._expr(stmt.value, state, emit)
+            self._use_check(stmt.target, state, emit)
+            self._kill_target(stmt.target, state)
+            return state
+        if isinstance(stmt, ast.Expr):
+            self._expr(stmt.value, state, emit)
+            return state
+        if isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self._expr(stmt.value, state, emit)
+            return None
+        if isinstance(stmt, (ast.Raise, ast.Break, ast.Continue)):
+            if isinstance(stmt, ast.Raise) and stmt.exc is not None:
+                self._expr(stmt.exc, state, emit)
+            return None
+        if isinstance(stmt, ast.If):
+            self._expr(stmt.test, state, emit)
+            a = self._block(stmt.body, state, emit)
+            b = self._block(stmt.orelse, state, emit)
+            return self._join(a, b)
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._expr(stmt.iter, state, emit)
+            self._kill_target(stmt.target, state)
+            once = self._block(stmt.body, state, emit=False)
+            looped = self._join(state, once)
+            body_out = self._block(stmt.body, looped or state, emit)
+            els = self._block(
+                stmt.orelse, self._join(state, body_out) or {}, emit
+            )
+            return self._join(state, body_out, els)
+        if isinstance(stmt, ast.While):
+            self._expr(stmt.test, state, emit)
+            once = self._block(stmt.body, state, emit=False)
+            looped = self._join(state, once)
+            body_out = self._block(stmt.body, looped or state, emit)
+            els = self._block(
+                stmt.orelse, self._join(state, body_out) or {}, emit
+            )
+            return self._join(state, body_out, els)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._expr(item.context_expr, state, emit)
+                if item.optional_vars is not None:
+                    self._kill_target(item.optional_vars, state)
+            return self._block(stmt.body, state, emit)
+        if isinstance(stmt, ast.Try):
+            body_out = self._block(stmt.body, state, emit)
+            handler_in = self._join(state, body_out) or dict(state)
+            h_outs = [
+                self._block(h.body, handler_in, emit) for h in stmt.handlers
+            ]
+            else_out = (
+                self._block(stmt.orelse, body_out, emit)
+                if body_out is not None
+                else None
+            )
+            merged = self._join(body_out if not stmt.orelse else else_out,
+                                *h_outs)
+            if stmt.finalbody:
+                merged = self._block(
+                    stmt.finalbody, merged or dict(state), emit
+                )
+            return merged
+        if isinstance(stmt, (ast.Delete,)):
+            for tgt in stmt.targets:
+                self._kill_target(tgt, state)
+            return state
+        # fallback: visit any expressions hanging off the statement
+        for _f, value in ast.iter_fields(stmt):
+            if isinstance(value, ast.expr):
+                self._expr(value, state, emit)
+            elif isinstance(value, list):
+                for v in value:
+                    if isinstance(v, ast.expr):
+                        self._expr(v, state, emit)
+        return state
+
+    # -- expressions ----------------------------------------------------------
+
+    def _expr(self, expr, state, emit) -> None:
+        """Check uses inside ``expr``, then apply any donations its calls
+        perform (arguments are evaluated before the dispatch consumes)."""
+        self._use_check(expr, state, emit)
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Call):
+                self._apply_donation(sub, state)
+
+    def _use_check(self, expr, state, emit) -> None:
+        if not state:
+            return
+
+        def walk(node, exempt: bool):
+            if isinstance(node, ast.Call):
+                name = _dotted(node.func) or ""
+                ex = exempt or name.endswith(_DONATE_CHECK_SUFFIX)
+                for child in ast.iter_child_nodes(node):
+                    walk(child, ex)
+                return
+            if isinstance(node, (ast.FunctionDef, ast.Lambda)):
+                return
+            path = _attr_path(node)
+            if path is not None:
+                hit = self._match(path, state)
+                if hit is not None and not exempt:
+                    self._flag(node, path, hit, emit)
+                return  # don't descend: the chain is matched as a whole
+            for child in ast.iter_child_nodes(node):
+                walk(child, exempt)
+
+        walk(expr, False)
+
+    def _match(self, path: str, state):
+        """A use hits when the used path IS a donated path, extends one
+        (``fr.nodes.shape`` after ``fr.nodes``), or is a donated path's
+        root object (``fr`` after ``fr`` itself was donated)."""
+        for donated, info in state.items():
+            if path == donated or path.startswith(donated + "."):
+                return (donated, info)
+        return None
+
+    def _flag(self, node, path, hit, emit) -> None:
+        if not emit:
+            return
+        donated, (entry, line) = hit
+        key = (getattr(node, "lineno", 0), path)
+        if key in self.emitted:
+            return
+        self.emitted.add(key)
+        self.linter._emit(
+            self.mod,
+            node,
+            "R10",
+            self.fi.qualname,
+            f"`{path}` is used after being DONATED to jit entry "
+            f"`{entry}` (line {line}) — the dispatch consumed the buffer "
+            "(jax deletes the handle); rebind from the call's result "
+            "before any further use",
+            def_line=self.fi.node.lineno,
+        )
+
+    def _kill_target(self, tgt, state) -> None:
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for el in tgt.elts:
+                self._kill_target(el, state)
+            return
+        if isinstance(tgt, ast.Starred):
+            self._kill_target(tgt.value, state)
+            return
+        path = _attr_path(tgt)
+        if path is None:
+            return
+        for donated in list(state):
+            if (
+                donated == path
+                or donated.startswith(path + ".")
+                or path.startswith(donated + ".")
+            ):
+                del state[donated]
+
+    # -- donation application -------------------------------------------------
+
+    def _apply_donation(self, call: ast.Call, state) -> None:
+        entry = self._entry_for_call(call)
+        if entry is not None and entry.donating:
+            positions = entry.donated_positions()
+            for i, arg in enumerate(call.args):
+                donate = i in positions
+                if not donate and entry.params and i < len(entry.params):
+                    donate = entry.params[i] in entry.donate_names
+                if donate:
+                    self._mark(arg, entry.name, call.lineno, state)
+            for kw in call.keywords:
+                if kw.arg is not None and kw.arg in entry.donate_names:
+                    self._mark(kw.value, entry.name, call.lineno, state)
+            return
+        # wrapper pattern: a donating entry passed BY NAME alongside a
+        # tuple of its arguments (the AOT dispatch helper)
+        ref = None
+        for arg in call.args:
+            name = _dotted(arg)
+            if name is None:
+                continue
+            cand = (
+                self.local_entries.get(name)
+                or self.linter.project._resolve_jit_entry(
+                    self.mod, self.fi, name
+                )
+            )
+            if cand is not None and cand.donating:
+                ref = cand
+                break
+        if ref is None:
+            return
+        for arg in call.args:
+            prefix = _tuple_prefix(arg)
+            if prefix is None:
+                continue
+            positions = ref.donated_positions()
+            for i, el in enumerate(prefix):
+                if i in positions:
+                    self._mark(el, ref.name, call.lineno, state)
+            break
+
+    def _entry_for_call(self, call: ast.Call) -> Optional[JitEntry]:
+        name = _dotted(call.func)
+        if name and name in self.local_entries:
+            return self.local_entries[name]
+        return self.linter.project._resolve_jit_entry(
+            self.mod, self.fi, name
+        )
+
+    def _mark(self, expr, entry_name: str, line: int, state) -> None:
+        for path in _donated_paths(expr):
+            state[path] = (entry_name, line)
+
+
+def _attr_path(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` -> "a.b.c" for pure Name/Attribute LOAD chains."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _donated_paths(expr: ast.AST) -> List[str]:
+    """Paths consumed when ``expr`` lands in a donated position: a bare
+    name, an attribute chain, or names wrapped in ``tuple(...)`` /
+    ``list(...)`` / tuple displays."""
+    path = _attr_path(expr)
+    if path is not None:
+        return [path]
+    if isinstance(expr, ast.Call):
+        name = (_dotted(expr.func) or "").rsplit(".", 1)[-1]
+        if name in ("tuple", "list") and len(expr.args) == 1:
+            return _donated_paths(expr.args[0])
+        return []
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for el in expr.elts:
+            out.extend(_donated_paths(el))
+        return out
+    if isinstance(expr, ast.Starred):
+        return _donated_paths(expr.value)
+    return []
+
+
+def _tuple_prefix(expr: ast.AST) -> Optional[List[ast.AST]]:
+    """The statically-known leading elements of a tuple expression:
+    ``(a, b, c)`` or ``(a, b) + rest`` -> [a, b, ...]."""
+    if isinstance(expr, ast.Tuple):
+        return list(expr.elts)
+    if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+        left = _tuple_prefix(expr.left)
+        return left
+    return None
+
+
+# -- public API ----------------------------------------------------------------
+
+
+def flow_project(
+    sources: Dict[str, str], rules: Optional[Iterable[str]] = None
+) -> List[Violation]:
+    """Analyze a {path: source} project (disable comments honored,
+    baseline NOT applied)."""
+    project = Project()
+    for path, src in sources.items():
+        project.add_module(path, src)
+    project.finalize()
+    linter = FlowLinter(
+        project, set(rules) if rules is not None else set(FLOW_RULES)
+    )
+    return linter.run()
+
+
+def flow_text(
+    source: str,
+    path: str = "<string>",
+    rules: Optional[Iterable[str]] = None,
+) -> List[Violation]:
+    """Analyze one source string as a single-module project."""
+    return flow_project({path: source}, rules=rules)
+
+
+def flow_paths(
+    paths: Sequence[pathlib.Path],
+    root: pathlib.Path,
+    rules: Optional[Iterable[str]] = None,
+) -> List[Violation]:
+    """Analyze every .py under ``paths`` as ONE project (imports resolve
+    across files); violation paths are ``root``-relative."""
+    project = Project()
+    for f in _iter_py_files(paths):
+        try:
+            source = f.read_text()
+        except (OSError, UnicodeDecodeError):
+            continue
+        try:
+            rel = f.resolve().relative_to(root.resolve()).as_posix()
+        except ValueError:
+            rel = f.as_posix()
+        project.add_module(rel, source)
+    project.finalize()
+    linter = FlowLinter(
+        project, set(rules) if rules is not None else set(FLOW_RULES)
+    )
+    return linter.run()

@@ -127,7 +127,7 @@ class Tracer:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._fh = None
-        self.path: Optional[str] = None
+        self._path: Optional[str] = None
         self._tls = threading.local()
         self._env_checked = False
 
@@ -143,7 +143,7 @@ class Tracer:
                 except OSError:
                     pass
                 self._fh = None
-            self.path = path
+            self._path = path
             self._env_checked = True
             if path:
                 self._fh = open(path, "a", encoding="utf-8")
@@ -159,16 +159,31 @@ class Tracer:
             self._env_checked = True
             path = os.environ.get("TSP_TRACE", "").strip()
             if path:
-                self.path = path
+                self._path = path
                 try:
                     self._fh = open(path, "a", encoding="utf-8")
                 except OSError:
-                    self.path = None
+                    self._path = None
 
     @property
     def active(self) -> bool:
         self._maybe_env_configure()
-        return self._fh is not None and _obs_enabled()
+        # double-checked: the lock-free read keeps the tracing-OFF fast
+        # path (every span()/add_event gate) off the lock emit() holds
+        # across file writes; the locked re-read below makes the
+        # tracing-ON answer consistent with a concurrent configure()
+        if self._fh is None:
+            return False
+        with self._lock:
+            fh = self._fh
+        return fh is not None and _obs_enabled()
+
+    @property
+    def path(self) -> Optional[str]:
+        """The configured sink path, read under the lock (graftflow R9:
+        ``configure`` rebinds it from whichever thread reconfigures)."""
+        with self._lock:
+            return self._path
 
     # -- stacks --------------------------------------------------------------
 

@@ -187,7 +187,8 @@ class FaultRegistry:
 
     @property
     def active(self) -> bool:
-        return bool(self._clauses)
+        with self._lock:  # configure() swaps the clause list (graftflow R9)
+            return bool(self._clauses)
 
     def _cross(self, seam: str) -> Tuple[int, Optional[FaultClause]]:
         if seam not in SEAMS:

@@ -224,6 +224,13 @@ class DeadlineLadder:
                     "serve_rung_seconds_total", max(elapsed, 0.0), tier=tier
                 )
 
+    def counts_snapshot(self) -> Tuple[Dict[str, int], Dict[str, int]]:
+        """``(tier_counts, rung_failures)`` copied under the count lock —
+        the accessor stats reporting must use instead of reaching into
+        the dicts while request threads increment them (graftflow R9)."""
+        with self._count_lock:
+            return dict(self.tier_counts), dict(self.rung_failures)
+
     def upgrade_eligible(
         self, n: int, deadline_s: float, entry_tier: str, certified_gap
     ) -> bool:

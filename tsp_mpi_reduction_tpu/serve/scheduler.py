@@ -203,8 +203,9 @@ class MicroBatchScheduler:
                     warmed += 1
                 except Exception:  # noqa: BLE001 — warmup is best-effort
                     continue
-        self.precompiled_buckets += warmed
-        self.precompile_seconds += time.monotonic() - t0
+        with self._cv:  # stats() snapshots these under the same lock
+            self.precompiled_buckets += warmed
+            self.precompile_seconds += time.monotonic() - t0
         return warmed
 
     # -- submission ----------------------------------------------------------
@@ -242,12 +243,17 @@ class MicroBatchScheduler:
         with self._cv:
             self._stop = True
             self._cv.notify_all()
-        for th in (self._thread, self._watchdog):
+            # snapshot the thread handles UNDER the lock (graftflow R9):
+            # a concurrent submit()'s revive path swaps them, and a stale
+            # handle here would join a replaced worker while the live one
+            # keeps running past close
+            threads = (self._thread, self._watchdog)
+        for th in threads:
             if th is not None:
                 th.join(timeout=30.0)
-        self._thread = None
-        self._watchdog = None
         with self._cv:
+            self._thread = None
+            self._watchdog = None
             pending = [t for t in self._inflight if not t._event.is_set()]
             pending += list(self._queue)
             self._inflight = []
@@ -464,9 +470,13 @@ class MicroBatchScheduler:
                 costs_np = np.asarray(costs)
                 tours_np = np.asarray(tours)
             dev_s = time.perf_counter() - t_dev0
-            self.batches += 1
-            self.blocks_solved += total
-            self.padded_blocks += bucket
+            # counter updates take the lock: after a stuck-revive an
+            # abandoned generation can run _run_batch concurrently with
+            # its successor, and stats() snapshots under the same lock
+            with self._cv:
+                self.batches += 1
+                self.blocks_solved += total
+                self.padded_blocks += bucket
             _REGISTRY.inc("serve_batches_total")
             _REGISTRY.inc("serve_blocks_solved_total", total)
             _REGISTRY.inc("serve_padded_lanes_total", bucket)
@@ -517,23 +527,29 @@ class MicroBatchScheduler:
     # -- stats ---------------------------------------------------------------
 
     def stats(self) -> Dict[str, float]:
-        return {
-            "batches": self.batches,
-            "blocks_solved": self.blocks_solved,
-            "padded_blocks": self.padded_blocks,
-            # occupancy: real blocks per dispatched lane (1.0 = no padding)
-            "batch_occupancy": (
-                self.blocks_solved / self.padded_blocks if self.padded_blocks else 0.0
-            ),
-            # mean real blocks per device call (the micro-batching win)
-            "mean_batch_blocks": (
-                self.blocks_solved / self.batches if self.batches else 0.0
-            ),
-            "queue_depth_hwm": self.queue_depth_hwm,
-            "full_flushes": self.full_flushes,
-            "wait_flushes": self.wait_flushes,
-            "worker_restarts": self.worker_restarts,
-            "stuck_restarts": self.stuck_restarts,
-            "precompiled_buckets": self.precompiled_buckets,
-            "precompile_seconds": round(self.precompile_seconds, 3),
-        }
+        # snapshot under the condition lock (graftflow R9): every counter
+        # below is mutated by the worker/watchdog/request threads holding
+        # ``_cv`` — an unlocked read here races those updates
+        with self._cv:
+            return {
+                "batches": self.batches,
+                "blocks_solved": self.blocks_solved,
+                "padded_blocks": self.padded_blocks,
+                # occupancy: real blocks per dispatched lane (1.0 = none)
+                "batch_occupancy": (
+                    self.blocks_solved / self.padded_blocks
+                    if self.padded_blocks
+                    else 0.0
+                ),
+                # mean real blocks per device call (the micro-batching win)
+                "mean_batch_blocks": (
+                    self.blocks_solved / self.batches if self.batches else 0.0
+                ),
+                "queue_depth_hwm": self.queue_depth_hwm,
+                "full_flushes": self.full_flushes,
+                "wait_flushes": self.wait_flushes,
+                "worker_restarts": self.worker_restarts,
+                "stuck_restarts": self.stuck_restarts,
+                "precompiled_buckets": self.precompiled_buckets,
+                "precompile_seconds": round(self.precompile_seconds, 3),
+            }

@@ -253,16 +253,19 @@ class SolveService:
         cache_stats = dict(self.cache.stats(), **{
             f"canonical_{k}": v for k, v in self.canon_cache.stats().items()
         })
+        # locked snapshots (graftflow R9): request threads increment the
+        # ladder counts and timer phases while this reporting path runs
+        tier_counts, rung_failures = self.ladder.counts_snapshot()
         return reporting.service_stats_json(
             responses=responses,
             errors=errors,
             deadline_misses=misses,
             refreshes=refreshes,
-            rung_failures=dict(self.ladder.rung_failures),
-            tier_counts=dict(self.ladder.tier_counts),
+            rung_failures=rung_failures,
+            tier_counts=tier_counts,
             cache=cache_stats,
             scheduler=self.scheduler.stats(),
-            phases_s=dict(self.timer.seconds),
+            phases_s=self.timer.snapshot(),
             # THIS session's recoveries, not the process's lifetime count
             # (registry-backed delta; see resilience.health)
             health=HEALTH.delta_since(self._health0),

@@ -58,6 +58,13 @@ class PhaseTimer:
         finally:
             self.add(name, time.perf_counter() - t0)
 
+    def snapshot(self) -> Dict[str, float]:
+        """Copy of the phase table taken under the lock — reporting paths
+        must use this instead of reading ``.seconds`` while other threads
+        :meth:`add` into it (graftflow R9)."""
+        with self._lock:
+            return dict(self.seconds)
+
     def add(self, name: str, seconds: float) -> None:
         """Accumulate an externally-measured duration into a phase — for
         costs measured by another layer (the compile cache times its own
