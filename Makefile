@@ -14,7 +14,7 @@ PY ?= python
 CXX ?= g++
 CXXFLAGS ?= -O2 -std=c++11
 
-.PHONY: all lint chaos native oracle test test-fast bench bench-serve bench-faults bench-compile bench-obs run sweep goldens clean
+.PHONY: all lint chaos native oracle test test-fast bench bench-serve bench-faults bench-compile bench-obs bench-step run sweep goldens clean
 
 all: lint native oracle chaos
 
@@ -85,6 +85,12 @@ bench-faults:
 # TSP_COMPILE_CACHE dir) -> BENCH_COMPILE_CACHE.json
 bench-compile:
 	TSP_BENCH=compile $(PY) bench.py
+
+# fused-vs-reference expansion-step bench (ISSUE 8): per-step ms +
+# nodes/s per kernel in fresh subprocesses, packed-row bytes ratio
+# -> BENCH_STEP_FUSED.json
+bench-step:
+	TSP_BENCH=step $(PY) bench.py
 
 # telemetry acceptance bench: full obs (metrics+tracing+sampler) vs
 # TSP_OBS=off B&B wall overhead (<= 2%) + serve span-tree completeness

@@ -611,6 +611,166 @@ def bench_spill() -> int:
     return 0
 
 
+def bench_step_child() -> int:
+    """One measured process of the step bench (``TSP_BENCH=step-child``):
+    chained transfer-free ``_expand_loop_ref`` dispatches of the real
+    expansion step under ONE step kernel (TSP_BENCH_STEP_KERNEL), one
+    readback at the end — the same method as tools/step_profile.py.
+    Prints one JSON line: ms/step, nodes popped, final incumbent (the
+    cross-kernel exactness check)."""
+    from tsp_mpi_reduction_tpu.utils.backend import (
+        enable_persistent_cache,
+        select_backend,
+    )
+
+    platform = select_backend(os.environ.get("TSP_BENCH_BACKEND", "auto"))
+    enable_persistent_cache(platform)
+
+    import jax
+    import jax.numpy as jnp
+
+    from tsp_mpi_reduction_tpu.models import branch_bound as bb
+    from tsp_mpi_reduction_tpu.utils import tsplib
+
+    kernel = os.environ.get("TSP_BENCH_STEP_KERNEL", "reference")
+    inst = tsplib.embedded(os.environ.get("TSP_BENCH_STEP_INSTANCE", "eil51"))
+    d = inst.distance_matrix()
+    n = d.shape[0]
+    k = int(os.environ.get("TSP_BENCH_STEP_K", "1024"))
+    steps = int(os.environ.get("TSP_BENCH_STEP_STEPS", "8"))
+    dispatches = int(os.environ.get("TSP_BENCH_STEP_DISPATCHES", "6"))
+    warm = int(os.environ.get("TSP_BENCH_STEP_WARM", "10"))
+    # MST re-bound off by default: the step kernels differ ONLY in the
+    # pop/sort/push data movement, so the A/B isolates exactly that
+    use_mst = os.environ.get("TSP_BENCH_STEP_MST", "0") == "1"
+    # capacity: the step-profile sizing, CAPPED so the fused leg's
+    # physical buffer (capacity + k*n padding rows) fits the compiled
+    # Pallas VMEM budget — otherwise the TPU fused leg would refuse at
+    # trace time and the acceptance artifact could never be captured.
+    # Both legs share the capacity so the A/B stays apples-to-apples.
+    from tsp_mpi_reduction_tpu.ops.expand_pallas import VMEM_BUDGET_BYTES
+
+    cols = bb._path_words(n) + (n + 31) // 32 + 4
+    fit_rows = VMEM_BUDGET_BYTES // (cols * 4) - k * n
+    capacity = int(os.environ.get(
+        "TSP_BENCH_STEP_CAPACITY",
+        min(max(1 << 17, 8 * k * (n - 1)), max(fit_rows, 4 * k * n)),
+    ))
+
+    bd = bb._bound_setup(d, "one-tree", node_ascent=0, ascent="host")
+    d64 = np.asarray(d, np.float64)
+    tour = bb.nearest_neighbor_tour(d64)
+    inc_cost = jnp.asarray(bb.tour_cost(d64, tour), jnp.float32)
+    inc_tour = jnp.asarray(tour, jnp.int32)
+    fr = bb.make_root_frontier(n, capacity, np.asarray(bd.min_out, np.float64))
+    d32 = jnp.asarray(d, jnp.float32)
+    args = (d32, bd.min_out, bd.bound_adj, bd.dbar, bd.pi, bd.slack,
+            bd.ascent_step, bd.lam_budget)
+
+    # warm to a realistic mid-search stack (reference kernel: both
+    # children must start from the IDENTICAL warm state)
+    fr, inc_cost, inc_tour, _ = bb._expand_loop_ref(
+        fr, inc_cost, inc_tour, *args, k, n, warm, bd.integral, True, 0,
+        "prim", "best-first", 0, "reference",
+    )
+
+    def dispatch(carry):
+        _, ic2, _, nodes = bb._expand_loop_ref(
+            fr, carry, inc_tour, *args, k, n, steps, bd.integral, use_mst,
+            0, "prim", "best-first", 0, kernel,
+        )
+        return ic2, nodes
+
+    t0 = time.perf_counter()
+    c, nodes = dispatch(inc_cost * 1.0)
+    jax.block_until_ready(c)
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(dispatches):
+        c, nodes = dispatch(c)
+    final = float(c)  # the ONE readback drains the chain
+    wall = time.perf_counter() - t0
+    print(json.dumps({
+        "step_kernel": kernel,
+        "ms_per_step": round(wall * 1000.0 / (dispatches * steps), 4),
+        "nodes_per_step": int(nodes) // max(steps, 1),
+        "nodes_per_sec": round(
+            int(nodes) * dispatches / max(wall, 1e-9), 1
+        ),
+        "final_incumbent": final,
+        "use_mst": use_mst,
+        "k": k, "n": n, "steps": steps, "dispatches": dispatches,
+        "compile_s": round(compile_s, 2),
+        "row_bytes": int(fr.nodes.shape[-1]) * 4,
+        "backend": platform,
+    }))
+    return 0
+
+
+def bench_step() -> int:
+    """``TSP_BENCH=step`` (ISSUE 8 acceptance): fused vs reference
+    expansion-step cost, each kernel measured in a FRESH subprocess
+    (compile caches and relay state cannot leak between legs), plus the
+    packed-row spill-bytes ratio vs the v1 unpacked layout. Writes
+    ``BENCH_STEP_FUSED.json`` (path: TSP_BENCH_STEP_OUT).
+
+    On TPU the fused kernel is the compiled Pallas path and the target
+    is >= 2x on the scatter+sort portion; on CPU the fused kernel runs
+    in INTERPRET mode (a correctness vehicle, not a speed claim) — the
+    artifact records both legs honestly with the backend label."""
+    import subprocess
+
+    out_path = os.environ.get("TSP_BENCH_STEP_OUT", "BENCH_STEP_FUSED.json")
+    legs = {}
+    for kernel in ("reference", "fused"):
+        env = dict(
+            os.environ, TSP_BENCH="step-child", TSP_BENCH_STEP_KERNEL=kernel
+        )
+        r = subprocess.run(
+            [sys.executable, __file__], capture_output=True, text=True,
+            env=env, timeout=1800,
+        )
+        sys.stderr.write(r.stderr[-2000:])
+        try:
+            legs[kernel] = json.loads(r.stdout.strip().splitlines()[-1])
+        except (json.JSONDecodeError, IndexError):
+            print(f"step bench: {kernel} leg produced no JSON "
+                  f"(rc={r.returncode})", file=sys.stderr)
+            return 1
+    ref, fus = legs["reference"], legs["fused"]
+    n = int(ref["n"])
+    v1_row_bytes = (n + (n + 31) // 32 + 4) * 4
+    artifact = {
+        "metric": "fused_vs_reference_expansion_step",
+        "reference": ref,
+        "fused": fus,
+        "speedup_fused_vs_reference": round(
+            ref["ms_per_step"] / max(fus["ms_per_step"], 1e-9), 3
+        ),
+        # the two kernels share every screen/ordering computation — the
+        # chained runs must converge to the SAME incumbent
+        "incumbent_match": ref["final_incumbent"] == fus["final_incumbent"],
+        "row_bytes_packed": ref["row_bytes"],
+        "row_bytes_v1_unpacked": v1_row_bytes,
+        "row_bytes_ratio": round(v1_row_bytes / ref["row_bytes"], 2),
+        "backend": ref["backend"],
+        "fused_mode": (
+            "compiled" if ref["backend"] == "tpu" else "interpret"
+        ),
+        "method": (
+            "chained transfer-free _expand_loop_ref dispatches, one "
+            "readback per fresh subprocess (tools/step_profile.py method)"
+        ),
+    }
+    from tsp_mpi_reduction_tpu.resilience.checkpoint import write_json_atomic
+
+    write_json_atomic(out_path, artifact)
+    print(json.dumps(artifact))
+    if not artifact["incumbent_match"]:
+        return 1
+    return 0
+
+
 def bench_serve() -> int:
     """Serving-layer acceptance bench (ISSUE 3): micro-batched vs
     sequential single-instance throughput on a same-shape workload, cache
@@ -962,6 +1122,12 @@ def main() -> int:
         # parent spawner only — must not initialize a jax backend (the
         # remote-TPU claim is exclusive per process; children claim it)
         return bench_compile()
+    if os.environ.get("TSP_BENCH") == "step-child":
+        # one measured kernel leg (selects its own backend)
+        return bench_step_child()
+    if os.environ.get("TSP_BENCH") == "step":
+        # parent spawner only — children claim the (exclusive) accelerator
+        return bench_step()
     if os.environ.get("TSP_BENCH") == "spill":
         # forces its own CPU virtual mesh — never probes the accelerator
         return bench_spill()

@@ -250,7 +250,7 @@ def test_reservoir_exchange_repartitions_globally():
             np.asarray(bounds, np.float32), np.zeros(m, np.float32),
         )
 
-    fr_rows = np.zeros((10, n + 1 + 4), np.int32)
+    fr_rows = np.zeros((10, bb._path_words(n) + 1 + 4), np.int32)
     fr_rows[:4] = rows([50.0, 40.0, 30.0, 99.0])  # 99: incumbent-closed
     fr = bb.Frontier(jnp.asarray(fr_rows), jnp.asarray(4, jnp.int32),
                      jnp.asarray(False))
@@ -267,7 +267,7 @@ def test_reservoir_exchange_repartitions_globally():
     # PARTIAL inversion (reservoir min between live min and live max):
     # the device already holds the global alive minimum, so the fast path
     # must fire — reservoir untouched, live rows best-half selected
-    fr_rows2 = np.zeros((10, n + 1 + 4), np.int32)
+    fr_rows2 = np.zeros((10, bb._path_words(n) + 1 + 4), np.int32)
     fr_rows2[:3] = rows([30.0, 50.0, 60.0])
     fr2 = bb.Frontier(jnp.asarray(fr_rows2), jnp.asarray(3, jnp.int32),
                       jnp.asarray(False))
@@ -283,7 +283,7 @@ def test_reservoir_exchange_repartitions_globally():
     # every live row dead (incumbent improved past them): the alive-
     # filtered guard sees an empty live minimum and must still run the
     # full merge so the reservoir's alive nodes come back on-device
-    fr_rows3 = np.zeros((10, n + 1 + 4), np.int32)
+    fr_rows3 = np.zeros((10, bb._path_words(n) + 1 + 4), np.int32)
     fr_rows3[:2] = rows([92.0, 95.0])  # both dead at inc=90
     fr3 = bb.Frontier(jnp.asarray(fr_rows3), jnp.asarray(2, jnp.int32),
                       jnp.asarray(False))
@@ -677,7 +677,7 @@ def test_reservoir_take0_respills_instead_of_dropping():
     import jax.numpy as jnp
 
     n = 6
-    fr_rows = np.zeros((8, n + 1 + 4), np.int32)
+    fr_rows = np.zeros((8, bb._path_words(n) + 1 + 4), np.int32)
     fr_rows[:3] = _packed_rows(n, [10.0, 20.0, 30.0])
     fr = bb.Frontier(jnp.asarray(fr_rows), jnp.asarray(3, jnp.int32),
                      jnp.asarray(False))
@@ -693,7 +693,7 @@ def test_reservoir_take0_respills_instead_of_dropping():
     # dead rows (above the incumbent) may still be dropped legitimately
     rv2 = bb._Reservoir()
     rv2.chunks.append(_packed_rows(n, [95.0]))
-    empty = bb.Frontier(jnp.asarray(np.zeros((8, n + 1 + 4), np.int32)),
+    empty = bb.Frontier(jnp.asarray(np.zeros((8, bb._path_words(n) + 1 + 4), np.int32)),
                         jnp.asarray(0, jnp.int32), jnp.asarray(False))
     out3 = rv2.exchange(empty, inc_cost=90.0, integral=False, capacity=1)
     assert int(out3.count) == 0 and len(rv2) == 0
@@ -708,7 +708,7 @@ def test_exchange_transfers_live_prefix_only():
     import jax.numpy as jnp
 
     n = 6
-    fr_rows = np.zeros((12, n + 1 + 4), np.int32)
+    fr_rows = np.zeros((12, bb._path_words(n) + 1 + 4), np.int32)
     fr_rows[:4] = _packed_rows(n, [50.0, 40.0, 30.0, 99.0])
     fr_rows[4:] = 7  # sentinel pattern in the dead region
     fr = bb.Frontier(jnp.asarray(fr_rows), jnp.asarray(4, jnp.int32),
@@ -725,7 +725,7 @@ def test_exchange_transfers_live_prefix_only():
     # all-dead live rows + empty reservoir: nothing to keep, and the very
     # buffer object is reused (no upload at all)
     rv3 = bb._Reservoir()
-    dead_rows = np.zeros((6, n + 1 + 4), np.int32)
+    dead_rows = np.zeros((6, bb._path_words(n) + 1 + 4), np.int32)
     dead_rows[:2] = _packed_rows(n, [95.0, 97.0])
     dead = bb.Frontier(jnp.asarray(dead_rows), jnp.asarray(2, jnp.int32),
                        jnp.asarray(False))
@@ -768,7 +768,7 @@ def test_exchange_rows_fast_full_equivalence():
                 if cb.size:
                     rv.chunks.append(_packed_rows(n, cb))
             live = _packed_rows(n, live_b) if n_live else np.zeros(
-                (0, n + 1 + 4), np.int32
+                (0, bb._path_words(n) + 1 + 4), np.int32
             )
             keep = rv.exchange_rows(live, inc, False, capacity, merge=merge)
             kept_b = (
@@ -816,7 +816,7 @@ def test_sharded_spill_counters_fast_path():
     )
     assert res.proven_optimal and res.cost == float(hk[0])
     assert res.spill_rounds > 0 and res.spill_events >= res.spill_rounds
-    width = n + 1 + 4
+    width = bb._path_words(n) + 1 + 4
     live_prefix_cap = res.spill_events * cap * width * 4
     phys_roundtrip = res.spill_rounds * 2 * ranks * (cap + k * n) * width * 4
     assert 0 < res.spill_bytes_to_host <= live_prefix_cap

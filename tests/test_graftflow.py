@@ -524,6 +524,40 @@ R10_DONATING = """
 """
 
 
+def test_r10_recognizes_fused_step_entry_and_rebind_discipline():
+    """ISSUE 8 satellite: a fused-step-shaped donating entry (step_kernel
+    static, Pallas push in the traced body) joins the R10 registry like
+    any other — host code re-reading the donated frontier after the
+    dispatch fires; the engine's rebind idiom stays quiet; the Pallas
+    call INSIDE the jit-traced body is skipped (traced, not host code)."""
+    fused_entry = """
+    import jax
+    from functools import partial
+    from jax.experimental import pallas as pl
+
+    @partial(jax.jit, static_argnames=("step_kernel",),
+             donate_argnames=("fr",))
+    def fused_step(fr, inc, step_kernel="fused"):
+        nodes = pl.pallas_call(
+            kern, out_shape=fr.nodes, input_output_aliases={0: 0}
+        )(fr.nodes)
+        return fr._replace(nodes=nodes), inc
+    """
+    vs = flow(fused_entry + """
+    def host_bad(fr, inc):
+        out, inc = fused_step(fr, inc)
+        return fr.nodes
+    """)
+    assert rules_of(vs) == ["R10"]
+    assert "fused_step" in vs[0].message
+    vs = flow(fused_entry + """
+    def host_good(fr, inc):
+        fr, inc = fused_step(fr, inc)
+        return fr.nodes
+    """)
+    assert vs == []
+
+
 def test_r10_fires_on_use_after_donate():
     vs = flow(R10_DONATING + """
     def host(fr, x):

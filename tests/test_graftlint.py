@@ -420,8 +420,10 @@ def test_check_frontier_rejects_bad_dtype_and_width():
     bad_width = bb.Frontier(fr.nodes[:, :5], fr.count, fr.overflow)
     with pytest.raises(contracts.ContractError, match="layout"):
         contracts.check_frontier(bad_width)
+    # v2 byte-packing: n is ambiguous WITHIN a path-word cell, so the
+    # mismatch must be asserted against an n from a different cell
     with pytest.raises(contracts.ContractError, match="expected n="):
-        contracts.check_frontier(fr, n=7)
+        contracts.check_frontier(fr, n=17)
 
 
 def test_check_frontier_rejects_bad_count_shape():
@@ -944,6 +946,35 @@ def test_r7_quiet_with_donate_argnums():
         ),
         rules={"R7"},
     ) == []
+
+
+def test_r7_fused_step_entry_shape_recognized():
+    """The ISSUE 8 fused-step entry: a donating jit whose body routes the
+    frontier through a Pallas pallas_call with input_output_aliases. R7
+    must see the donation (quiet), and the same entry WITHOUT donation
+    must still fire — the Pallas aliasing is not a substitute for the
+    dispatch-level donation R7 enforces."""
+    fused = """
+        import jax
+        from functools import partial
+        from jax.experimental import pallas as pl
+
+        @partial(jax.jit, static_argnames=("k", "n", "step_kernel"),
+                 donate_argnames=("fr",))
+        def _expand_step(fr, inc, k, n, step_kernel="fused"):
+            new_nodes = pl.pallas_call(
+                _push_kernel,
+                out_shape=fr.nodes,
+                input_output_aliases={0: 0},
+            )(fr.nodes)
+            return fr._replace(nodes=new_nodes)
+    """
+    assert lint(fused, rules={"R7"}) == []
+    undonated = fused.replace(
+        ',\n                 donate_argnames=("fr",)', ''
+    )
+    vs = lint(undonated, rules={"R7"})
+    assert rules_of(vs) == ["R7"] and "fr" in vs[0].message
 
 
 def test_r7_flags_bare_jit_decorator():

@@ -368,7 +368,8 @@ BNB_PAYLOAD_SCHEMA = {
     "nodes_expanded": int, "nodes_per_sec": float, "time_to_best_s": float,
     "wall_s": float, "setup_s": float, "setup_ascent_s": float,
     "setup_ils_s": float, "ranks": int, "bound": str, "mst_kernel": str,
-    "push_order": str, "push_block": int, "root_lower_bound": float,
+    "step_kernel": str, "push_order": str, "push_block": int,
+    "root_lower_bound": float,
     "lower_bound": float, "lb_certified": float, "spill_rounds": int,
     "spill_events": int, "spill_full_merges": int, "spill_bytes_to_host": int,
     "spill_bytes_to_device": int, "health": dict, "compile_cache": dict,
@@ -445,6 +446,7 @@ def test_bnb_solve_payload_golden_schema():
         ranks = 1
         bound = "min-out"
         mst_kernel = "prim"
+        step_kernel = "reference"
         push_order = "best-first"
         push_block = 0
         balance = "pair"
@@ -455,6 +457,10 @@ def test_bnb_solve_payload_golden_schema():
         assert isinstance(payload[key], typ), (key, type(payload[key]))
     json.dumps(payload)  # the driver's contract: one encodable JSON line
     assert payload["series"]["columns"] == list(timeseries.COLUMNS)
+    # packed-row provenance rides the series (spill bytes / row_bytes =
+    # rows moved; v2 = int8-packed path layout)
+    assert payload["series"]["row_bytes"] == res.series["row_bytes"]
+    assert payload["series"]["frontier_layout"] >= 2
     assert payload["obs"]["enabled"] is True
     assert payload["balance"] is None  # single-rank runs report no scheme
 

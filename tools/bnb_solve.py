@@ -28,8 +28,8 @@ def result_payload(res, inst, args) -> dict:
     """The driver's one-line JSON metrics payload — split out of main()
     so its schema is directly testable (tests/test_obs.py golden-schema
     suite) and reusable by the obs bench leg. ``args`` needs the solver
-    config attributes (ranks/bound/mst_kernel/push_order/push_block/
-    balance); any argparse.Namespace-alike works."""
+    config attributes (ranks/bound/mst_kernel/step_kernel/push_order/
+    push_block/balance); any argparse.Namespace-alike works."""
     opt = inst.known_optimum
     return {
         "instance": inst.name,
@@ -63,6 +63,7 @@ def result_payload(res, inst, args) -> dict:
         ),
         "bound": args.bound,
         "mst_kernel": args.mst_kernel,
+        "step_kernel": getattr(args, "step_kernel", "reference"),
         "push_order": args.push_order,
         "push_block": args.push_block,
         "balance": args.balance if args.ranks > 1 else None,
@@ -150,6 +151,14 @@ def main() -> int:
         "recorded negative result); all certify the identical bound value",
     )
     ap.add_argument(
+        "--step-kernel", default="reference", choices=["reference", "fused"],
+        help="expansion-step push kernel: reference (XLA candidate-block "
+        "materialize + compacting gather + block write) or fused "
+        "(ops.expand_pallas — one Pallas kernel builds and stores pushed "
+        "child rows in place; the candidate block never materializes). "
+        "Bit-identical results; fused runs in interpret mode off-TPU",
+    )
+    ap.add_argument(
         "--push-order", default="best-first", choices=["best-first", "natural"],
         help="per-step push ordering: best-first (two-level sort, stack "
         "top = best child) or natural (no sort: cheaper steps but the "
@@ -230,6 +239,7 @@ def main() -> int:
                 balance=args.balance,
                 push_order=args.push_order,
                 push_block=args.push_block,
+                step_kernel=args.step_kernel,
             )
         else:
             res = bb.solve(
@@ -249,6 +259,7 @@ def main() -> int:
                 mst_kernel=args.mst_kernel,
                 push_order=args.push_order,
                 push_block=args.push_block,
+                step_kernel=args.step_kernel,
             )
 
     print(json.dumps(result_payload(res, inst, args)))

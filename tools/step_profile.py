@@ -137,6 +137,7 @@ def child(args) -> int:
         integral_f = bool(integral)
 
         w = (n + 31) // 32
+        pw = bb._path_words(n)
         kn = k * n
 
         def stage_once(f, c):
@@ -144,17 +145,17 @@ def child(args) -> int:
             idx = jnp.maximum(f.count - 1 - lanes, 0)
             live = lanes < take
             p = f.nodes[idx]  # one packed-row gather
-            p_path = p[:, :n]
-            p_mask = p[:, n : n + w].astype(jnp.uint32)
-            p_depth = p[:, n + w]
-            p_cost = bb._f32(p[:, n + w + 1]) + c * 0.0  # carry dependency
-            p_bound = bb._f32(p[:, n + w + 2])
-            p_sum = bb._f32(p[:, n + w + 3])
+            p_pathw = p[:, :pw]  # int8-packed prefix words (layout v2)
+            p_mask = p[:, pw : pw + w].astype(jnp.uint32)
+            p_depth = p[:, pw + w]
+            p_cost = bb._f32(p[:, pw + w + 1]) + c * 0.0  # carry dependency
+            p_bound = bb._f32(p[:, pw + w + 2])
+            p_sum = bb._f32(p[:, pw + w + 3])
             if integral_f:
                 live = live & (p_bound <= c - 1.0)
             else:
                 live = live & (p_bound < c)
-            cur = p_path[lanes, jnp.maximum(p_depth - 1, 0)]
+            cur = bb._path_byte_get(p_pathw, jnp.maximum(p_depth - 1, 0))
             unvis = (p_mask[:, word_idx] >> bit[None, :]) & 1 == 0
             feasible = unvis & live[:, None]
             ccost = p_cost[:, None] + d32[cur]
@@ -170,17 +171,18 @@ def child(args) -> int:
                 push = feasible & ~is_complete & (cbound < new_inc)
             child_mask = p_mask[:, None, :] | set_bit[None, :, :]
             child_sum = p_sum[:, None] - bd.min_out[None, :]
-            child_path = jnp.broadcast_to(p_path[:, None, :], (k, n, n))
-            child_path = jnp.where(
-                (jnp.arange(n)[None, None, :]
-                 == jnp.minimum(p_depth[:, None, None], n - 1)),
-                cities[None, :, None],
-                child_path,
-            )
+            # packed child path words (the v2 byte-set, as in _expand_step)
+            dpos = jnp.minimum(p_depth, n - 1)
+            wsel = (dpos // bb.PATH_PACK)[:, None, None]
+            shift = ((dpos % bb.PATH_PACK) * 8)[:, None, None]
+            pwb = jnp.broadcast_to(p_pathw[:, None, :], (k, n, pw))
+            widx = jnp.arange(pw, dtype=jnp.int32)[None, None, :]
+            neww = (pwb & ~(0xFF << shift)) | (cities[None, :, None] << shift)
+            child_pathw = jnp.where(widx == wsel, neww, pwb)
             if comp == "popgather":
                 s = (
                     jnp.sum(jnp.where(push, cbound, 0.0))
-                    + jnp.sum(child_path).astype(jnp.float32)
+                    + jnp.sum(child_pathw).astype(jnp.float32)
                     + jnp.sum(child_mask).astype(jnp.float32)
                     + jnp.sum(child_sum)
                 )
@@ -215,7 +217,7 @@ def child(args) -> int:
                 return f, jnp.minimum(new_inc, jnp.abs(s) + 1e6)
             cand = jnp.concatenate(
                 [
-                    child_path.reshape(-1, n),
+                    child_pathw.reshape(-1, pw),
                     child_mask.reshape(-1, w).astype(jnp.int32),
                     jnp.broadcast_to(cdepth, (k, n)).reshape(-1)[:, None],
                     bb._i32(ccost.reshape(-1))[:, None],
@@ -256,6 +258,7 @@ def child(args) -> int:
                 bd.dbar, bd.pi, bd.slack, bd.ascent_step, bd.lam_budget,
                 jnp.asarray(args.steps, jnp.int32), k, n, integral, True,
                 na, 0, jnp.asarray(0, jnp.int32), kern,
+                "best-first", 0, args.step_kernel,
             )
             return ic2
 
@@ -272,6 +275,7 @@ def child(args) -> int:
                 fr, carry, inc_tour, d32, bd.min_out, bd.bound_adj,
                 bd.dbar, bd.pi, bd.slack, bd.ascent_step, bd.lam_budget,
                 k, n, args.steps, integral, use_mst, na, kern,
+                "best-first", 0, args.step_kernel,
             )
             return ic2
 
@@ -339,6 +343,11 @@ def main() -> int:
     ap.add_argument("--mst-kernel", default=None,
                     help="override the MST kernel for full_*/bound_*/"
                     "guarded components (e.g. prim_pallas)")
+    ap.add_argument("--step-kernel", default="reference",
+                    choices=["reference", "fused"],
+                    help="expansion push kernel for full_*/guarded "
+                    "components: reference (XLA cand block) or fused "
+                    "(ops.expand_pallas in-place Pallas push)")
     ap.add_argument("--warm-steps", type=int, default=10)
     ap.add_argument("--steps", type=int, default=10,
                     help="expansion steps per timed dispatch")
@@ -395,6 +404,7 @@ def main() -> int:
         "k": args.k,
         "node_ascent": args.node_ascent,
         "mst_kernel": args.mst_kernel or "prim (default)",
+        "step_kernel": args.step_kernel,
         "method": "chained transfer-free dispatches, one readback per "
         "component subprocess; warmup drains into the first window "
         "(<=1/dispatches overstatement)",

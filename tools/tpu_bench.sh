@@ -39,6 +39,13 @@ if [ ! -s STEP_PROFILE_FINE_R5_TPU.json ]; then
     [ -s STEP_PROFILE_FINE_R5_TPU.json ] || rm -f STEP_PROFILE_FINE_R5_TPU.json
 fi
 
+if [ ! -s BENCH_STEP_FUSED_TPU.json ]; then
+    echo "== r6 fused-vs-reference expansion step (ISSUE 8, compiled Pallas) =="
+    TSP_BENCH=step TSP_BENCH_STEP_OUT=BENCH_STEP_FUSED_TPU.json \
+        python bench.py 2> >(tail -3 >&2) || true
+    [ -s BENCH_STEP_FUSED_TPU.json ] || rm -f BENCH_STEP_FUSED_TPU.json
+fi
+
 if [ ! -s BENCH_BNB_TPU_R5.json ]; then
     echo "== r5 B&B eil51 recapture (north-star metric, final engine) =="
     TSP_BENCH=bnb python bench.py 2> >(tail -3 >&2) | tee BENCH_BNB_TPU_R5.json

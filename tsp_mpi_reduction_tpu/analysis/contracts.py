@@ -28,7 +28,7 @@ the two failure classes that only exist at runtime:
 from __future__ import annotations
 
 import os
-from typing import Any, Dict, Mapping, Optional
+from typing import Any, Dict, Mapping, Optional, Tuple
 
 import jax
 import numpy as np
@@ -68,15 +68,19 @@ def _dtype(x: Any) -> np.dtype:
     return np.dtype(dt) if dt is not None else np.dtype(type(x))
 
 
-def _frontier_layout(cols: int) -> Optional[int]:
-    """Invert ``cols = n + ceil(n/32) + 4`` -> n, or None if not a valid
-    packed-row width (mirrors models.branch_bound._layout, duplicated so
-    the analysis package never imports the engine)."""
-    n = max((cols - 4) * 32 // 33, 1)
-    for cand in range(max(n - 2, 1), n + 3):
-        if cand + (cand + 31) // 32 + 4 == cols:
-            return cand
-    return None
+def _frontier_layout(cols: int) -> Optional[Tuple[int, int]]:
+    """Invert the v2 packed-row width ``cols = ceil(n/4) + ceil(n/32) + 4``
+    to the inclusive ``(n_lo, n_hi)`` range of consistent city counts, or
+    None if no n fits (mirrors models.branch_bound._layout, duplicated so
+    the analysis package never imports the engine). Byte-packing makes n
+    ambiguous within one path-word cell, but the cell itself is unique —
+    see the engine's _layout docstring."""
+    lo = hi = None
+    for n in range(1, min((cols - 5) * 4, 32 * (cols - 5)) + 1):
+        if (n + 3) // 4 + (n + 31) // 32 + 4 == cols:
+            lo = n if lo is None else lo
+            hi = n
+    return None if lo is None else (lo, hi)
 
 
 def _fail(where: str, msg: str) -> None:
@@ -99,11 +103,15 @@ def check_frontier(fr, *, n: Optional[int] = None, where: str = ""):
     if nodes.dtype != np.int32:
         _fail(where, f"Frontier.nodes must be int32 packed rows, got {nodes.dtype}")
     cols = nodes.shape[-1]
-    got_n = _frontier_layout(cols)
-    if got_n is None:
+    rng = _frontier_layout(cols)
+    if rng is None:
         _fail(where, f"Frontier row width {cols} inverts to no valid (n, W) layout")
-    if n is not None and got_n != n:
-        _fail(where, f"Frontier row width {cols} encodes n={got_n}, expected n={n}")
+    if n is not None and not rng[0] <= n <= rng[1]:
+        _fail(
+            where,
+            f"Frontier row width {cols} encodes n in [{rng[0]}, {rng[1]}], "
+            f"expected n={n}",
+        )
     want_count_shape = () if nodes.ndim == 2 else nodes.shape[:1]
     if tuple(count.shape) != want_count_shape:
         _fail(where, f"Frontier.count shape {count.shape}, expected {want_count_shape}")
@@ -116,6 +124,57 @@ def check_frontier(fr, *, n: Optional[int] = None, where: str = ""):
         rows = nodes.shape[-2]
         if (cnt < 0).any() or (cnt > rows).any():
             _fail(where, f"Frontier.count {cnt} outside [0, {rows}] buffer rows")
+    return fr
+
+
+def check_frontier_packed(fr, n: int, *, where: str = ""):
+    """Value-level contract on the v2 int8-packed row layout (ISSUE 8):
+    every LIVE row's packed path must be well-formed for instance size
+    ``n`` — city-id bytes < n at prefix positions below ``depth``, and
+    pad lanes past n all zero (the invariant that keeps the byte-set
+    kernels exact and the host pack/unpack bit-stable). Runs the cheap
+    structural :func:`check_frontier` first; the byte checks are
+    STRICT-level only (they unpack concrete arrays — test territory,
+    like the other value checks). Returns ``fr``.
+    """
+    lv = level()
+    if lv == "off":
+        return fr
+    check_frontier(fr, n=n, where=where)
+    if (
+        lv != "strict"
+        or not _is_concrete(fr.nodes)
+        or not _is_concrete(fr.count)
+    ):
+        return fr
+    nodes = np.asarray(fr.nodes)
+    counts = np.atleast_1d(np.asarray(fr.count))
+    rows2d = nodes.reshape(-1, nodes.shape[-2], nodes.shape[-1])
+    pw = (n + 3) // 4
+    for r in range(rows2d.shape[0]):
+        live = rows2d[r, : int(counts[r])]
+        if not live.size:
+            continue
+        words = np.ascontiguousarray(live[:, :pw]).view(np.uint32)
+        shifts = (np.arange(4, dtype=np.uint32) * 8)
+        lanes = ((words[:, :, None] >> shifts) & np.uint32(0xFF)).reshape(
+            live.shape[0], -1
+        )
+        depth = live[:, -4]
+        pos = np.arange(lanes.shape[1])[None, :]
+        in_prefix = pos < depth[:, None]
+        if (lanes[in_prefix & (pos < n)] >= n).any():
+            _fail(
+                where,
+                f"packed path carries a city id >= n={n} inside a live "
+                "prefix (corrupt byte-packed row)",
+            )
+        if lanes[:, n:].any():
+            _fail(
+                where,
+                f"packed path pad lanes past n={n} are non-zero "
+                "(byte-set wrote outside the prefix)",
+            )
     return fr
 
 

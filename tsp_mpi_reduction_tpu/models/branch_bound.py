@@ -72,17 +72,79 @@ except ImportError:  # jaxlib layout drift: keep the portable subset
 
 INF = jnp.inf
 
+#: frontier node-row layout version (v2 = int8-packed tour prefix). The
+#: canonical constant lives in perf.compile_cache so the AOT executable
+#: key can include it without importing the engine; re-exported here
+#: because the layout is defined by THIS module.
+FRONTIER_LAYOUT_VERSION = _perf_cache.FRONTIER_LAYOUT_VERSION
+
+#: city ids packed per int32 path word. 4 = int8 lanes — exact for every
+#: supported instance (city ids < MAX_BNB_CITIES = 200 < 256); a future
+#: n > 255 engine would drop to 2 (int16 lanes) per the same scheme.
+PATH_PACK = 4
+
+
+def _path_words(n: int) -> int:
+    """int32 words holding the packed [n]-city tour prefix (P)."""
+    return (n + PATH_PACK - 1) // PATH_PACK
+
 
 def _layout(cols: int) -> Tuple[int, int]:
-    """Invert the packed-row width: given ``cols = n + ceil(n/32) + 4``,
-    return ``(n, W)``. ``n + ceil(n/32)`` is strictly increasing in n, so
-    the solution is unique."""
-    n = max((cols - 4) * 32 // 33, 1)
-    for cand in range(max(n - 2, 1), n + 3):
-        w = (cand + 31) // 32
-        if cand + w + 4 == cols:
-            return cand, w
+    """Invert the packed-row width ``cols = P + W + 4`` (P = ceil(n/4)
+    path words, W = ceil(n/32) mask words) to ``(n, W)``.
+
+    Byte-packing makes the exact n ambiguous within one (P, W) cell —
+    n and n+1 share a path word for 3 of every 4 values — but the
+    OFFSETS (P, W) are unique: the map n -> (P(n), W(n)) is monotone and
+    P+W strictly increases across cell boundaries, so a given width
+    admits exactly one consistent cell. The returned ``n`` is the cell's
+    MAXIMUM (4P, clipped to the mask words): enough for every offset/
+    view computation. Code that needs the exact n (checkpoint unpack,
+    value-level contracts) threads it explicitly.
+    """
+    for n_hi in range(min((cols - 5) * PATH_PACK, 32 * (cols - 5)), 0, -1):
+        w = (n_hi + 31) // 32
+        if _path_words(n_hi) + w + 4 == cols:
+            return n_hi, w
     raise ValueError(f"no valid (n, W) layout for packed row width {cols}")
+
+
+def _layout_n_range(cols: int) -> Tuple[int, int]:
+    """The inclusive [n_lo, n_hi] range of city counts consistent with a
+    packed row width (see _layout's ambiguity note)."""
+    n_hi, w = _layout(cols)
+    n_lo = n_hi
+    while n_lo > 1 and _path_words(n_lo - 1) + ((n_lo - 1 + 31) // 32) + 4 == cols:
+        n_lo -= 1
+    return n_lo, n_hi
+
+
+def _pack_path_np(path: np.ndarray, n: int) -> np.ndarray:
+    """Host-side path packing: [..., n] city ids -> [..., P] int32 words,
+    4 uint8 lanes per word (byte j of word w holds city 4w+j). Explicit
+    shifts, not a dtype view, so the byte order is endian-independent
+    and matches the kernel's shift arithmetic bit-for-bit."""
+    p = _path_words(n)
+    padded = np.zeros(path.shape[:-1] + (p * PATH_PACK,), np.uint32)
+    padded[..., :n] = np.asarray(path, np.int64) & 0xFF
+    lanes = padded.reshape(path.shape[:-1] + (p, PATH_PACK))
+    words = (
+        lanes[..., 0]
+        | (lanes[..., 1] << 8)
+        | (lanes[..., 2] << 16)
+        | (lanes[..., 3] << 24)
+    )
+    return words.astype(np.uint32).view(np.int32)
+
+
+def _unpack_path_np(words: np.ndarray, n: int) -> np.ndarray:
+    """Host-side inverse of ``_pack_path_np``: [..., P] words -> [..., n]."""
+    u = np.ascontiguousarray(words).view(np.uint32)
+    shifts = np.arange(PATH_PACK, dtype=np.uint32) * 8
+    lanes = (u[..., :, None] >> shifts) & np.uint32(0xFF)
+    return (
+        lanes.reshape(words.shape[:-1] + (-1,))[..., :n].astype(np.int32)
+    )
 
 
 def _f32(words: jnp.ndarray) -> jnp.ndarray:
@@ -96,16 +158,19 @@ def _i32(vals: jnp.ndarray) -> jnp.ndarray:
 
 
 class Frontier(NamedTuple):
-    """Packed frontier: ONE ``[F, n + W + 4]`` int32 node buffer.
+    """Packed frontier: ONE ``[F, P + W + 4]`` int32 node buffer.
 
-    Row column layout (W = ceil(n/32) visited-bitmask words):
+    Row column layout v2 (P = ceil(n/4) path words, W = ceil(n/32)
+    visited-bitmask words — FRONTIER_LAYOUT_VERSION):
 
-        [0, n)      path    int32 city prefix (undefined past depth)
-        [n, n+W)    mask    visited bitmask words (uint32 bit patterns)
-        n+W         depth   int32
-        n+W+1       cost    float32 prefix cost (bitcast)
-        n+W+2       bound   float32 admissible lower bound (bitcast)
-        n+W+3       sum_min float32 sum of min_out over unvisited (bitcast)
+        [0, P)      path    int8-packed city prefix: 4 uint8 ids per
+                            int32 word, byte j of word w = city 4w+j
+                            (undefined past depth; pad bytes past n stay 0)
+        [P, P+W)    mask    visited bitmask words (uint32 bit patterns)
+        P+W         depth   int32
+        P+W+1       cost    float32 prefix cost (bitcast)
+        P+W+2       bound   float32 admissible lower bound (bitcast)
+        P+W+3       sum_min float32 sum of min_out over unvisited (bitcast)
 
     Why one buffer instead of the round-3 six-array SoA: every operation
     that moves nodes (the push scatter, reorder/compact gathers, ring-
@@ -113,12 +178,23 @@ class Frontier(NamedTuple):
     on TPU the cost is per-op, not per-byte — the on-chip A/B
     (SCATTER_PROFILE_TPU.json, live-carry harness) measured the
     six-scatter push at 6.86 ms vs 2.32 ms for one packed scatter
-    (gather+DUS variant: 1.46 ms — a possible future step, needs k*n
-    write padding). The logical fields remain available as read-only
-    property views (cheap slices, fused by XLA).
+    (gather+DUS variant: 1.46 ms). The logical fields remain available
+    as read-only property views (cheap slices, fused by XLA).
+
+    Why int8-packed path words (v2, ISSUE 8): the tour prefix dominated
+    the row — n full int32 lanes carrying values < 256. Packing 4 ids
+    per word shrinks every cost that scales with row bytes by ~3x at
+    n=100 (row 432 -> 132 bytes): the push write, reorder/compact
+    gathers, balance slabs, reservoir spill traffic, checkpoint size.
+    Bounds stay f32 bitcast columns (screened in f32; f64 only at the
+    certification boundaries in _bound_setup), exactly as before.
+
+    The trailing four scalar columns sit at FIXED offsets from the row
+    END (cols-4..cols-1), so width-only consumers (the bound column
+    slice in parallel.reduce, host spill partitioning) never need n.
     """
 
-    nodes: jnp.ndarray  # [F, n + W + 4] int32 packed rows (see layout above)
+    nodes: jnp.ndarray  # [F, P + W + 4] int32 packed rows (see layout above)
     count: jnp.ndarray  # scalar int32: stack height
     #: scalar bool: a push batch overran capacity INSIDE the kernel (children
     #: silently dropped -> exactness lost). solve()'s spill-to-reservoir keeps
@@ -128,62 +204,97 @@ class Frontier(NamedTuple):
     overflow: jnp.ndarray
 
     @property
-    def _nw(self) -> Tuple[int, int]:
-        return _layout(self.nodes.shape[-1])
+    def _pw(self) -> Tuple[int, int]:
+        n_hi, w = _layout(self.nodes.shape[-1])
+        return _path_words(n_hi), w
+
+    @property
+    def path_words(self) -> jnp.ndarray:
+        """The raw [..., P] int8-packed path words."""
+        return self.nodes[..., : self._pw[0]]
+
+    def path_view(self, n: int) -> jnp.ndarray:
+        """Unpacked [..., n] int32 city prefix (device op: byte extract).
+        Needs the exact ``n`` — the width only bounds it (see _layout)."""
+        return _unpack_path(self.path_words, n)
 
     @property
     def path(self) -> jnp.ndarray:
-        return self.nodes[..., : self._nw[0]]
+        """Unpacked [..., n_hi] city prefix for host/test convenience,
+        where n_hi is the layout-maximum n for this width (true-n callers
+        slice ``[..., :n]`` or use :meth:`path_view`; pad lanes are 0)."""
+        return _unpack_path(self.path_words, _layout(self.nodes.shape[-1])[0])
 
     @property
     def mask(self) -> jnp.ndarray:
-        n, w = self._nw
+        p, w = self._pw
         # int32 -> uint32 is a modular convert == bitcast: same words
-        return self.nodes[..., n : n + w].astype(jnp.uint32)
+        return self.nodes[..., p : p + w].astype(jnp.uint32)
 
     @property
     def depth(self) -> jnp.ndarray:
-        n, w = self._nw
-        return self.nodes[..., n + w]
+        return self.nodes[..., -4]
 
     @property
     def cost(self) -> jnp.ndarray:
-        n, w = self._nw
-        return _f32(self.nodes[..., n + w + 1])
+        return _f32(self.nodes[..., -3])
 
     @property
     def bound(self) -> jnp.ndarray:
-        n, w = self._nw
-        return _f32(self.nodes[..., n + w + 2])
+        return _f32(self.nodes[..., -2])
 
     @property
     def sum_min(self) -> jnp.ndarray:
-        n, w = self._nw
-        return _f32(self.nodes[..., n + w + 3])
+        return _f32(self.nodes[..., -1])
 
 
 #: the logical per-node fields, in packed-column order — the checkpoint
 #: format (save/restore serialize these, NOT the packed buffer, so the
-#: .npz layout is stable across engine-internal layout changes)
+#: .npz layout is stable across engine-internal layout changes — a v1
+#: unpacked-path-era snapshot restores into the v2 packed layout)
 CKPT_NODE_FIELDS = ("path", "mask", "depth", "cost", "bound", "sum_min")
 
 
-def _unpack_rows_np(rows: np.ndarray) -> dict:
+def _unpack_path(words: jnp.ndarray, n: int) -> jnp.ndarray:
+    """Device-side path unpack: [..., P] int32 words -> [..., n] city ids.
+    Arithmetic >> sign-extends negative words; the & 0xFF mask restores
+    the unsigned byte, so every id round-trips exactly."""
+    shifts = (jnp.arange(PATH_PACK, dtype=jnp.int32) * 8)[None, :]
+    lanes = (words[..., :, None] >> shifts) & 0xFF
+    return lanes.reshape(words.shape[:-1] + (-1,))[..., :n]
+
+
+def _path_byte_get(words: jnp.ndarray, pos: jnp.ndarray) -> jnp.ndarray:
+    """City id at prefix position ``pos`` per row: words [..., P] int32,
+    pos [...] int32 -> [...] int32."""
+    word = jnp.take_along_axis(words, (pos // PATH_PACK)[..., None], axis=-1)[
+        ..., 0
+    ]
+    return (word >> ((pos % PATH_PACK) * 8)) & 0xFF
+
+
+def _unpack_rows_np(rows: np.ndarray, n: Optional[int] = None) -> dict:
     """Host-side inverse of ``_pack_rows_np``: packed int32 rows -> the
-    logical field arrays (pure numpy views/copies, no device work)."""
-    n, w = _layout(rows.shape[-1])
+    logical field arrays (pure numpy, no device work). ``n``: the exact
+    city count; None takes the layout-maximum for the width (path then
+    carries up to 3 all-zero pad lanes — fine for width-only consumers,
+    NOT for checkpoint payloads, whose shape is the format)."""
+    n_hi, w = _layout(rows.shape[-1])
+    if n is None:
+        n = n_hi
+    p = _path_words(n_hi)
     rows = np.ascontiguousarray(rows)
 
     def fcol(c):
         return np.ascontiguousarray(rows[..., c]).view(np.float32)
 
     return {
-        "path": rows[..., :n],
-        "mask": np.ascontiguousarray(rows[..., n : n + w]).view(np.uint32),
-        "depth": rows[..., n + w],
-        "cost": fcol(n + w + 1),
-        "bound": fcol(n + w + 2),
-        "sum_min": fcol(n + w + 3),
+        "path": _unpack_path_np(rows[..., :p], n),
+        "mask": np.ascontiguousarray(rows[..., p : p + w]).view(np.uint32),
+        "depth": rows[..., -4],
+        "cost": fcol(-3),
+        "bound": fcol(-2),
+        "sum_min": fcol(-1),
     }
 
 
@@ -196,7 +307,7 @@ def _pack_rows_np(path, mask, depth, cost, bound, sum_min) -> np.ndarray:
 
     return np.concatenate(
         [
-            np.asarray(path, np.int32),
+            _pack_path_np(np.asarray(path), np.shape(path)[-1]),
             np.ascontiguousarray(np.asarray(mask, np.uint32)).view(np.int32),
             np.asarray(depth, np.int32)[..., None],
             fbits(cost)[..., None],
@@ -936,12 +1047,14 @@ def _batched_mst_bound(
     jax.jit,
     static_argnames=(
         "k", "n", "integral", "use_mst", "node_ascent", "mst_kernel",
-        "push_order", "push_block",
+        "push_order", "push_block", "step_kernel",
     ),
     # the popped frontier is dead after every call (callers rebind the
     # returned one) — donating it lets XLA alias the multi-hundred-MB
     # node buffer in place instead of copying it per top-level dispatch
-    # (under _expand_loop's trace the inner donation is simply inlined)
+    # (under _expand_loop's trace the inner donation is simply inlined;
+    # the fused step kernel's input_output_aliases rides the same
+    # donation — the Pallas store IS the in-place push)
     donate_argnames=("fr",),
 )
 def _expand_step(
@@ -964,8 +1077,17 @@ def _expand_step(
     mst_kernel: str = "prim",
     push_order: str = "best-first",
     push_block: int = 0,
+    step_kernel: str = "reference",
 ):
     """Pop <=K nodes, expand, prune, push. Returns (frontier', inc', stats).
+
+    ``step_kernel``: "reference" (XLA cand-block materialize + compacting
+    gather + contiguous block write — the default everywhere) or "fused"
+    (ops.expand_pallas: child rows built and stored in-place by one
+    Pallas kernel, the candidate block never materialized — the
+    bandwidth-bound form; opt-in, interpret-mode off TPU). Both paths
+    share every screen/flag/ordering computation, so results are
+    bit-identical; only dead rows past ``count`` can differ.
 
     ``integral``: the metric is integer-valued and the bound arrays are
     fixed-point-exact (_bound_setup), so a node with ``bound > inc - 1``
@@ -1003,28 +1125,39 @@ def _expand_step(
         # predicate never fires) while compiling a mis-shaped dead branch
         # and mislabeling the A/B artifact
         raise ValueError(f"push_block must be >= 0, got {push_block}")
+    if step_kernel not in ("reference", "fused"):
+        raise ValueError(
+            f"unknown step_kernel {step_kernel!r} (expected reference|fused)"
+        )
+    if step_kernel == "fused" and push_block:
+        # push_block is an A/B knob on the reference block write; the
+        # fused kernel writes exactly the pushed rows, so a cap is
+        # meaningless — mislabeling the artifact silently would be worse
+        raise ValueError("push_block is a reference-kernel knob; "
+                         "step_kernel='fused' writes pushed rows only")
     f_cap = f_phys - k * n  # logical capacity
     w = (n + 31) // 32
+    pw = _path_words(n)
     lanes = jnp.arange(k, dtype=jnp.int32)
     # pop the top-of-stack K entries (stack grows upward): ONE row gather
     # of the packed buffer, then column views
     take = jnp.minimum(fr.count, k)
     idx = jnp.maximum(fr.count - 1 - lanes, 0)  # top-first
     live = lanes < take
-    p = fr.nodes[idx]  # [k, n + W + 4]
-    p_path = p[:, :n]
-    p_mask = p[:, n : n + w].astype(jnp.uint32)
-    p_depth = p[:, n + w]
-    p_cost = _f32(p[:, n + w + 1])
-    p_bound = _f32(p[:, n + w + 2])
-    p_sum = _f32(p[:, n + w + 3])
+    p = fr.nodes[idx]  # [k, P + W + 4]
+    p_pathw = p[:, :pw]  # int8-packed prefix words
+    p_mask = p[:, pw : pw + w].astype(jnp.uint32)
+    p_depth = p[:, pw + w]
+    p_cost = _f32(p[:, pw + w + 1])
+    p_bound = _f32(p[:, pw + w + 2])
+    p_sum = _f32(p[:, pw + w + 3])
     # pop-side re-prune: the incumbent may have improved since these nodes
     # were pushed — discard (already-popped) nodes that can no longer win
     if integral:
         live = live & (p_bound <= inc_cost - 1.0)
     else:
         live = live & (p_bound < inc_cost)
-    cur = p_path[lanes, jnp.maximum(p_depth - 1, 0)]
+    cur = _path_byte_get(p_pathw, jnp.maximum(p_depth - 1, 0))
 
     _, word_idx, bit, set_bit = _mask_consts(n)
     cities = jnp.arange(n, dtype=jnp.int32)
@@ -1068,7 +1201,11 @@ def _expand_step(
     bi = (best_flat // n).astype(jnp.int32)
     bc = (best_flat % n).astype(jnp.int32)
     new_inc_cost = jnp.minimum(inc_cost, best_total)
-    best_path = p_path[bi].at[jnp.minimum(p_depth[bi], n - 1)].set(bc)
+    best_path = (
+        _unpack_path(p_pathw[bi], n)
+        .at[jnp.minimum(p_depth[bi], n - 1)]
+        .set(bc)
+    )
     # closed tour layout [n+1]: prefix + final city + return-to-0
     cand_tour = jnp.zeros(n + 1, jnp.int32).at[:n].set(best_path)
     new_inc_tour = jnp.where(best_total < inc_cost, cand_tour, inc_tour)
@@ -1080,14 +1217,7 @@ def _expand_step(
         push = feasible & ~is_complete & (cbound <= new_inc_cost - 1.0)
     else:
         push = feasible & ~is_complete & (cbound < new_inc_cost)
-    child_mask = p_mask[:, None, :] | set_bit[None, :, :]  # [k, n, W]
     child_sum = p_sum[:, None] - min_out[None, :]
-    child_path = jnp.broadcast_to(p_path[:, None, :], (k, n, n))
-    child_path = jnp.where(
-        (jnp.arange(n)[None, None, :] == jnp.minimum(p_depth[:, None, None], n - 1)),
-        cities[None, :, None],
-        child_path,
-    )
 
     # order pushes bound-DESC so the stack top is best-first. A single flat
     # argsort over all k*n keys is the dominant cost of the whole step on
@@ -1139,56 +1269,86 @@ def _expand_step(
     n_push = flat_push.sum()
     base = fr.count - take
 
-    # the payload columns mirror the Frontier layout
-    cand = jnp.concatenate(
-        [
-            child_path.reshape(-1, n),
-            child_mask.reshape(-1, w).astype(jnp.int32),
-            jnp.broadcast_to(cdepth, (k, n)).reshape(-1)[:, None],
-            _i32(ccost.reshape(-1))[:, None],
-            _i32(cbound.reshape(-1))[:, None],
-            _i32(child_sum.reshape(-1))[:, None],
-        ],
-        axis=1,
-    )
-    # push = compacting gather + ONE contiguous block write (on-chip
-    # live-carry A/B: 1.46 ms vs 2.32 ms for the row scatter and 6.9 ms
-    # for the round-3 six-scatter form): gather the pushed candidates to
-    # the block prefix in priority order, then dynamic_update_slice the
-    # whole k*n block at the stack top. Rows past n_push are garbage —
-    # they land beyond the new count and every consumer masks by count.
-    comp_idx = jnp.zeros(kn, jnp.int32).at[
-        jnp.where(flat_push, rank, kn)
-    ].set(jnp.arange(kn, dtype=jnp.int32), mode="drop")
+    if step_kernel == "fused":
+        # fused Pallas push (ops.expand_pallas, ISSUE 8): destination
+        # slots from the SAME rank/prefix-sum as the reference path;
+        # the kernel builds each pushed child's packed row in VMEM and
+        # stores it in place — the [kn, cols] candidate block below is
+        # never materialized. Pruned candidates park at f_phys (skipped).
+        from ..ops.expand_pallas import push_rows
 
-    def _block_write(nodes, rows: int):
-        # while the count<=f_cap invariant holds, base+rows <= f_phys and
-        # the clamp is a no-op; if a caller breaks it (e.g. resuming a
-        # checkpoint with a larger k), the clamped write overlaps live
-        # rows — flagged below so exactness loss is never silent (same
-        # honesty as scatter-drop was)
-        block = cand[comp_idx[:rows]]
-        start = jnp.minimum(base, f_phys - rows)
-        # literal 0 would trace as int64 under x64 mode; match start dtype
-        return jax.lax.dynamic_update_slice(
-            nodes, block, (start, jnp.zeros((), start.dtype))
-        )
-
-    if push_block and push_block < kn:
-        # capped block write (scatter_profile v4): typical steps push ~k
-        # rows, so gathering/writing the full k*n block materializes ~92%
-        # garbage; cap the common case at push_block rows and lax.cond to
-        # the full block on the (counted-rare) steps where n_push exceeds
-        # it — both branches write every pushed row, so exactness is
-        # unconditional
-        new_nodes = jax.lax.cond(
-            n_push <= push_block,
-            lambda nodes: _block_write(nodes, push_block),
-            lambda nodes: _block_write(nodes, kn),
-            fr.nodes,
+        dest = jnp.where(
+            flat_push, base + rank, jnp.asarray(f_phys, jnp.int32)
+        ).reshape(k, n)
+        new_nodes = push_rows(
+            fr.nodes, p, dest, ccost, cbound, child_sum, n
         )
     else:
-        new_nodes = _block_write(fr.nodes, kn)
+        # reference path: materialize the candidate block, compact, one
+        # contiguous block write. Child rows are built in the packed
+        # layout: path words [k, n, P] with the child id byte-set at the
+        # prefix position (v1 built full [k, n, n] int32 lanes — 4x the
+        # traffic of this form at n=100).
+        dpos = jnp.minimum(p_depth, n - 1)
+        wsel = (dpos // PATH_PACK)[:, None, None]
+        shift = ((dpos % PATH_PACK) * 8)[:, None, None]
+        pwb = jnp.broadcast_to(p_pathw[:, None, :], (k, n, pw))
+        widx = jnp.arange(pw, dtype=jnp.int32)[None, None, :]
+        neww = (pwb & ~(0xFF << shift)) | (cities[None, :, None] << shift)
+        child_pathw = jnp.where(widx == wsel, neww, pwb)
+        child_mask = p_mask[:, None, :] | set_bit[None, :, :]  # [k, n, W]
+
+        # the payload columns mirror the Frontier layout
+        cand = jnp.concatenate(
+            [
+                child_pathw.reshape(-1, pw),
+                child_mask.reshape(-1, w).astype(jnp.int32),
+                jnp.broadcast_to(cdepth, (k, n)).reshape(-1)[:, None],
+                _i32(ccost.reshape(-1))[:, None],
+                _i32(cbound.reshape(-1))[:, None],
+                _i32(child_sum.reshape(-1))[:, None],
+            ],
+            axis=1,
+        )
+        # push = compacting gather + ONE contiguous block write (on-chip
+        # live-carry A/B: 1.46 ms vs 2.32 ms for the row scatter and 6.9 ms
+        # for the round-3 six-scatter form): gather the pushed candidates to
+        # the block prefix in priority order, then dynamic_update_slice the
+        # whole k*n block at the stack top. Rows past n_push are garbage —
+        # they land beyond the new count and every consumer masks by count.
+        comp_idx = jnp.zeros(kn, jnp.int32).at[
+            jnp.where(flat_push, rank, kn)
+        ].set(jnp.arange(kn, dtype=jnp.int32), mode="drop")
+
+        def _block_write(nodes, rows: int):
+            # while the count<=f_cap invariant holds, base+rows <= f_phys
+            # and the clamp is a no-op; if a caller breaks it (e.g.
+            # resuming a checkpoint with a larger k), the clamped write
+            # overlaps live rows — flagged below so exactness loss is
+            # never silent (same honesty as scatter-drop was)
+            block = cand[comp_idx[:rows]]
+            start = jnp.minimum(base, f_phys - rows)
+            # literal 0 would trace int64 under x64 mode; match start dtype
+            return jax.lax.dynamic_update_slice(
+                nodes, block, (start, jnp.zeros((), start.dtype))
+            )
+
+        if push_block and push_block < kn:
+            # capped block write (scatter_profile v4): typical steps push
+            # ~k rows, so gathering/writing the full k*n block
+            # materializes ~92% garbage; cap the common case at
+            # push_block rows and lax.cond to the full block on the
+            # (counted-rare) steps where n_push exceeds it — both
+            # branches write every pushed row, so exactness is
+            # unconditional
+            new_nodes = jax.lax.cond(
+                n_push <= push_block,
+                lambda nodes: _block_write(nodes, push_block),
+                lambda nodes: _block_write(nodes, kn),
+                fr.nodes,
+            )
+        else:
+            new_nodes = _block_write(fr.nodes, kn)
 
     new_count = base + n_push.astype(jnp.int32)
     overflow = fr.overflow | (new_count > f_cap) | (base > f_phys - kn)
@@ -1224,6 +1384,7 @@ def _expand_loop_impl(
     mst_kernel: str = "prim",
     push_order: str = "best-first",
     push_block: int = 0,
+    step_kernel: str = "reference",
 ):
     """Run up to ``inner_steps`` expansion steps in ONE device program.
 
@@ -1240,7 +1401,7 @@ def _expand_loop_impl(
         fr, ic, itour, stats = _expand_step(
             fr, ic, itour, d, min_out, bound_adj, dbar, pi, mst_slack,
             ascent_step, lam_budget, k, n, integral, use_mst, node_ascent,
-            mst_kernel, push_order, push_block
+            mst_kernel, push_order, push_block, step_kernel
         )
         return fr, ic, itour, nodes + stats["popped"], i + 1
 
@@ -1255,7 +1416,7 @@ def _expand_loop_impl(
 
 _EXPAND_LOOP_STATICS = (
     "k", "n", "inner_steps", "integral", "use_mst", "node_ascent",
-    "mst_kernel", "push_order", "push_block",
+    "mst_kernel", "push_order", "push_block", "step_kernel",
 )
 
 #: the production entry: the frontier argument is DONATED — the caller's
@@ -1297,10 +1458,10 @@ def _reorder_frontier(fr: Frontier, rows=None) -> Frontier:
     live_nodes = fr.nodes[:rows]
     pos = jnp.arange(rows, dtype=jnp.int32)
     live = pos < fr.count
-    n, w = _layout(fr.nodes.shape[-1])
     # DESC by bound: worst live node at index 0, best at count-1 (stack
-    # top), dead entries (-inf keys) pushed past the live prefix
-    key = _f32(live_nodes[:, n + w + 2])
+    # top), dead entries (-inf keys) pushed past the live prefix (the
+    # bound column is always the second-to-last packed column)
+    key = _f32(live_nodes[:, -2])
     perm = jnp.argsort(-jnp.where(live, key, -INF))
     return Frontier(
         fr.nodes.at[:rows].set(live_nodes[perm]), fr.count, fr.overflow
@@ -1328,8 +1489,7 @@ def _compact_frontier(fr: Frontier, inc_cost, integral: bool, rows=None) -> Fron
     live_nodes = fr.nodes[:rows]
     pos = jnp.arange(rows, dtype=jnp.int32)
     live = pos < fr.count
-    n, w = _layout(fr.nodes.shape[-1])
-    bound = _f32(live_nodes[:, n + w + 2])
+    bound = _f32(live_nodes[:, -2])
     if integral:
         alive = live & (bound <= inc_cost - 1.0)
     else:
@@ -1348,7 +1508,7 @@ def _compact_frontier(fr: Frontier, inc_cost, integral: bool, rows=None) -> Fron
     jax.jit,
     static_argnames=(
         "k", "n", "integral", "use_mst", "node_ascent", "reorder_every",
-        "mst_kernel", "push_order", "push_block",
+        "mst_kernel", "push_order", "push_block", "step_kernel",
     ),
     # one whole-search dispatch per call; the input frontier is dead the
     # moment the kernel starts — donate it so the reservoir-scale buffer
@@ -1378,6 +1538,7 @@ def _solve_device(
     mst_kernel: str = "prim",
     push_order: str = "best-first",
     push_block: int = 0,
+    step_kernel: str = "reference",
 ):
     """Run the ENTIRE search (up to ``max_steps`` expansion steps) in one
     device dispatch, with on-device stack compaction under capacity
@@ -1400,7 +1561,8 @@ def _solve_device(
     return _guarded_expand_steps(
         fr, inc_cost, inc_tour, d, min_out, bound_adj, dbar, pi, mst_slack,
         ascent_step, lam_budget, max_steps, k, n, integral, use_mst,
-        node_ascent, reorder_every, step0, mst_kernel, push_order, push_block
+        node_ascent, reorder_every, step0, mst_kernel, push_order,
+        push_block, step_kernel
     )
 
 
@@ -1409,6 +1571,7 @@ def _guarded_expand_steps(
     ascent_step, lam_budget, max_steps, k, n, integral, use_mst, node_ascent,
     reorder_every: int = 0, step0=0, mst_kernel: str = "prim",
     push_order: str = "best-first", push_block: int = 0,
+    step_kernel: str = "reference",
 ):
     """Up to ``max_steps`` expansion steps with a PER-STEP capacity guard:
     compact under pressure, and if compaction cannot get below the
@@ -1469,7 +1632,7 @@ def _guarded_expand_steps(
             fr, ic, itour, stats = _expand_step(
                 fr, ic, itour, d, min_out, bound_adj, dbar, pi, mst_slack,
                 ascent_step, lam_budget, k, n, integral, use_mst,
-                node_ascent, mst_kernel, push_order, push_block
+                node_ascent, mst_kernel, push_order, push_block, step_kernel
             )
             return fr, ic, itour, stats["popped"]
 
@@ -1492,9 +1655,9 @@ def _guarded_expand_steps(
 
 
 def _np_bound_col(rows: np.ndarray) -> np.ndarray:
-    """The float32 bound column of packed host rows (see Frontier layout)."""
-    n, w = _layout(rows.shape[-1])
-    return np.ascontiguousarray(rows[..., n + w + 2]).view(np.float32)
+    """The float32 bound column of packed host rows: always the
+    second-to-last packed column (see Frontier layout)."""
+    return np.ascontiguousarray(rows[..., -2]).view(np.float32)
 
 
 def _fetch_live_rows(nodes: jnp.ndarray, cnt: int) -> np.ndarray:
@@ -1727,15 +1890,16 @@ def make_root_frontier(
     if dtype != jnp.float32:
         raise ValueError("the packed frontier stores float32 fields only")
     w = (n + 31) // 32
+    pw = _path_words(n)
     # packed rows: all-zero == {path 0, mask 0, depth 0, cost/bound/sum 0.0}.
     # Built ON DEVICE (zeros + one tiny row write): materializing the
-    # buffer host-side would push capacity*(n+W+4)*4 bytes (tens of MB)
+    # buffer host-side would push capacity*(P+W+4)*4 bytes (tens of MB)
     # through the relay tunnel — measured ~2.7 s of the eil51 solve
-    row0 = np.zeros(n + w + 4, np.int32)
-    row0[n] = 1  # mask word 0: city 0 visited
-    row0[n + w] = 1  # depth
-    row0[n + w + 3] = np.float32(min_out[1:].sum()).view(np.int32)
-    nodes = jnp.zeros((capacity + pad_rows, n + w + 4), jnp.int32).at[0].set(row0)
+    row0 = np.zeros(pw + w + 4, np.int32)
+    row0[pw] = 1  # mask word 0: city 0 visited
+    row0[pw + w] = 1  # depth
+    row0[pw + w + 3] = np.float32(min_out[1:].sum()).view(np.int32)
+    nodes = jnp.zeros((capacity + pad_rows, pw + w + 4), jnp.int32).at[0].set(row0)
     return Frontier(nodes, jnp.asarray(1, jnp.int32), jnp.asarray(False))
 
 
@@ -1837,6 +2001,7 @@ def warm_compile_device_solver(
     mst_kernel: str = "prim",
     push_order: str = "best-first",
     push_block: int = 0,
+    step_kernel: str = "reference",
 ) -> None:
     """AOT-compile ``_solve_device`` for the given static shapes WITHOUT
     executing anything on the device.
@@ -1848,18 +2013,19 @@ def warm_compile_device_solver(
     dispatch then hits the cache instead of recompiling.
     """
     w = (n + 31) // 32
+    pw = _path_words(n)
     sd = jax.ShapeDtypeStruct
     f32, i32 = jnp.float32, jnp.int32
     # + k*n push-padding rows, matching solve()'s make_root_frontier call
     fr = Frontier(
-        sd((capacity + k * n, n + w + 4), i32), sd((), i32), sd((), jnp.bool_)
+        sd((capacity + k * n, pw + w + 4), i32), sd((), i32), sd((), jnp.bool_)
     )
     _solve_device.lower(
         fr, sd((), f32), sd((n + 1,), i32), sd((n, n), f32), sd((n,), f32),
         sd((n,), f32), sd((n, n), f32), sd((n,), f32), sd((), f32),
         sd((), f32), sd((), f32), sd((), i32), sd((), i32), k, n, integral,
         mst_prune, node_ascent, reorder_every, mst_kernel, push_order,
-        push_block
+        push_block, step_kernel
     ).compile()
 
 
@@ -1884,8 +2050,15 @@ def solve(
     mst_kernel: str = "prim",
     push_order: str = "best-first",
     push_block: int = 0,
+    step_kernel: str = "reference",
 ) -> BnBResult:
     """Exact B&B on one device. ``d`` is a dense [n, n] distance matrix.
+
+    ``step_kernel``: "reference" (default — the XLA candidate-block
+    push) or "fused" (ops.expand_pallas: one Pallas kernel builds and
+    stores pushed child rows in place; opt-in like --mst-kernel, with
+    interpret-mode fallback off TPU). Results are bit-identical — the
+    two kernels share every screen/ordering computation.
 
     ``push_order``: "best-first" (default — two-level sort keeps the
     stack top on the best child) or "natural" (no per-step sort: cheaper
@@ -2003,7 +2176,7 @@ def solve(
         inc_tour = jnp.asarray(inc_tour_np, jnp.int32)
         fr = make_root_frontier(n, capacity, min_out_np, pad_rows=k * n)
 
-    _contracts.check_frontier(fr, n=n, where="solve")
+    _contracts.check_frontier_packed(fr, n, where="solve")
     headroom = _spill_headroom(capacity, inner_steps, k, n)
 
     # compile-once dispatch (perf.compile_cache): when the cache is
@@ -2039,11 +2212,13 @@ def solve(
         k=k, n=n, integral=integral, use_mst=mst_prune,
         node_ascent=node_ascent, reorder_every=reorder_every,
         mst_kernel=mst_kernel, push_order=push_order, push_block=push_block,
+        step_kernel=step_kernel,
     )
     _el_statics = dict(
         k=k, n=n, inner_steps=max(1, inner_steps), integral=integral,
         use_mst=mst_prune, node_ascent=node_ascent, mst_kernel=mst_kernel,
         push_order=push_order, push_block=push_block,
+        step_kernel=step_kernel,
     )
     t0 = time.perf_counter()
     setup_s = t0 - t_setup
@@ -2060,6 +2235,10 @@ def solve(
     # iteration — host-side values the loop already has, zero extra
     # device traffic; None (one is-None check per iteration) when off
     sampler = _obs_series.StepSampler.maybe()
+    if sampler is not None:
+        # spill byte columns count packed rows — record the divisor
+        sampler.row_bytes = int(fr.nodes.shape[-1]) * 4
+        sampler.frontier_layout = FRONTIER_LAYOUT_VERSION
     # profiler step annotation, resolved ONCE (shared nullcontext unless
     # a device_trace capture is live around this solve)
     step_ann = _obs_tracing.step_annotation_factory()
@@ -2321,6 +2500,7 @@ def solve_sharded(
     balance: str = "pair",
     push_order: str = "best-first",
     push_block: int = 0,
+    step_kernel: str = "reference",
 ) -> BnBResult:
     """Mesh-parallel B&B: per-rank frontiers, collective incumbent sharing.
 
@@ -2455,7 +2635,7 @@ def solve_sharded(
             np.broadcast_to(inc_tour_np, (num_ranks, n + 1)).copy(), spec
         )
 
-    _contracts.check_frontier(fr, n=n, where="solve_sharded")
+    _contracts.check_frontier_packed(fr, n, where="solve_sharded")
     t_slots = int(transfer) if transfer is not None else max(k, 64)
     t_slots = min(t_slots, capacity_per_rank // 4)
     perm_fwd = [(r, (r + 1) % num_ranks) for r in range(num_ranks)]
@@ -2540,7 +2720,7 @@ def solve_sharded(
             local, ic_l[0], itour_l[0], d_rep, mo_rep, ba_rep, dbar_rep,
             pi_rep, slack_rep, step_rep, budget_rep, k, n, inner_steps,
             integral, mst_prune, node_ascent, mst_kernel, push_order,
-            push_block
+            push_block, step_kernel
         )
         if num_ranks > 1:
             f2 = balance_fn(f2, it_rep)
@@ -2642,6 +2822,7 @@ def solve_sharded(
                 mst_kernel=mst_kernel,
                 push_order=push_order,
                 push_block=push_block,
+                step_kernel=step_kernel,
             )
             if num_ranks > 1:
                 # round_i counts BALANCE EVENTS, not steps: step counts
@@ -2814,6 +2995,9 @@ def solve_sharded(
     last_reorder = 0
     rounds_rate = 0.0  # measured in-dispatch rounds/sec of the last dispatch
     sampler = _obs_series.StepSampler.maybe()
+    if sampler is not None:
+        sampler.row_bytes = int(fr.nodes.shape[-1]) * 4
+        sampler.frontier_layout = FRONTIER_LAYOUT_VERSION
     step_ann = _obs_tracing.step_annotation_factory()
     while it < max_iters:
         t_iter = time.perf_counter()
@@ -3051,12 +3235,17 @@ def save(
     # replaced into the rotation chain with an integrity header — a
     # writer killed at ANY byte offset can no longer destroy the campaign
     # (the legacy direct np.savez_compressed could; see resilience/)
+    # the TSPCKPT header records which engine-internal row layout wrote
+    # this snapshot (diagnostics only: the payload stores LOGICAL fields,
+    # so any layout version restores any snapshot; legacy headerless /
+    # pre-key snapshots read fine — see restore())
     _ckpt_store.write_atomic(
         _norm_ckpt_path(path),
         _ckpt_store.npz_bytes(**payload),
         fingerprint=(
             _ckpt_store.instance_fingerprint(d) if d is not None else None
         ),
+        extra_header={"frontier_layout": FRONTIER_LAYOUT_VERSION},
     )
 
 
@@ -3075,13 +3264,16 @@ def _ckpt_payload(
     atomic store on byte-identical payloads."""
     # ONE device->host transfer of the packed buffer, then host-side
     # column unpacking (the property views would issue six separate
-    # slice/bitcast kernels + transfers through the relay)
+    # slice/bitcast kernels + transfers through the relay). The exact n
+    # comes from the closed incumbent tour ([n+1] ids) — the byte-packed
+    # path words alone only bound it (see _layout)
+    n_exact = int(np.shape(inc_tour)[-1]) - 1
     payload = {
         "inc_cost": np.asarray(inc_cost),
         "inc_tour": np.asarray(inc_tour),
         "count": np.asarray(fr.count),
         "overflow": np.asarray(fr.overflow),
-        **_unpack_rows_np(np.asarray(fr.nodes)),
+        **_unpack_rows_np(np.asarray(fr.nodes), n=n_exact),
     }
     if d is not None:
         payload["d_fingerprint"] = _d_fingerprint(d)
@@ -3118,7 +3310,9 @@ def _ckpt_payload(
     if reservoir is not None and len(reservoir):
         # pure host-side unpack — the reservoir exists precisely because
         # device memory ran out, so it must never round-trip the device
-        res_fields = _unpack_rows_np(np.concatenate(reservoir.chunks))
+        res_fields = _unpack_rows_np(
+            np.concatenate(reservoir.chunks), n=n_exact
+        )
         for f in CKPT_NODE_FIELDS:
             payload[f"res_{f}"] = res_fields[f]
     return payload

@@ -39,6 +39,13 @@ class StepSampler:
         self.capacity = capacity
         self._rows: List[List[Any]] = []
         self._total = 0
+        #: bytes per packed frontier node row, set once by the solver —
+        #: the spill_to_host/spill_to_device columns count ACTUAL packed
+        #: bytes (they shrank ~3x with the v2 int8-packed layout), so the
+        #: series records the divisor that converts them to node counts
+        self.row_bytes: Optional[int] = None
+        #: engine row-layout version the bytes were measured under
+        self.frontier_layout: Optional[int] = None
 
     @classmethod
     def maybe(cls, capacity: int = 512) -> Optional["StepSampler"]:
@@ -102,4 +109,8 @@ class StepSampler:
             "rows": rows,
             "samples_total": self._total,
             "samples_dropped": max(self._total - self.capacity, 0),
+            # packed-row provenance: spill byte columns / row_bytes =
+            # rows moved; None when the producer never set it
+            "row_bytes": self.row_bytes,
+            "frontier_layout": self.frontier_layout,
         }

@@ -1,0 +1,233 @@
+"""Fused Pallas push for the B&B expansion step (ISSUE 8 tentpole).
+
+STEP_PROFILE_FINE_TPU.json prices the push scatter at 4.5-6.9 ms of a
+~5 ms TPU expansion step while the pop gather and the two-level sort
+cost ~0.3 ms each: the step is not compute-bound, it is bound on the
+memory traffic of materializing the [k*n, C] candidate-row block —
+write it, gather-compact it, write the compacted block again — of
+which typically >90% is garbage (most candidates are pruned). This is
+the FlashAttention situation (Dao et al., NeurIPS '22, PAPERS.md): the
+win is not FLOPs but never materializing the intermediate.
+
+``push_rows`` is that fusion: ONE Pallas kernel that walks the popped
+parents and, per parent, builds each surviving child's packed node row
+IN REGISTERS/VMEM (int8-packed path byte-set + visited-mask word OR +
+the four scalar columns) and stores it directly at its prefix-sum slot
+in the frontier buffer — which is input/output-ALIASED, so the push is
+a true in-place write riding the engine's donation discipline (lint R7
+/ contracts.check_donated cover the jit entry that traces this call).
+The candidate block never exists; per step the kernel reads ~k rows +
+four [k, n] scalar planes and writes exactly the pushed rows.
+
+Division of labor with ``models.branch_bound._expand_step`` (the ONE
+dispatch both kernels live inside):
+
+- the bound screen, completion/incumbent reduction, push flags, and the
+  destination prefix-sum stay in XLA — [k] / [k, n] scalar planes, two
+  orders of magnitude smaller than row traffic, and sharing them is
+  what makes the fused and reference paths BIT-IDENTICAL by
+  construction (same flags, same slots, same float columns; only the
+  row materialization + write differ);
+- this kernel replaces the cand-concat + compacting gather + block
+  write — the measured dominant cost.
+
+Ordering support: the destination slots come in pre-computed, so both
+``push_order`` modes (two-level best-first sort, natural prefix-sum)
+work unchanged through the fused path.
+
+Like ops/prim_pallas.py and ops/held_karp_pallas.py the kernel is
+OPT-IN (``--step-kernel=fused`` / TSP_BENCH_STEP_KERNEL) and falls
+back to interpret mode off-TPU, where the parity suite
+(tests/test_expand_pallas.py) pins fused == reference bit-exactness.
+COMPILED use additionally requires the frontier buffer to fit the
+conservative VMEM budget below (the kernel addresses the whole buffer
+as one block; the HBM-resident DMA variant is future work and must be
+validated on-chip first — no TPU was attached when this kernel
+landed), and is refused loudly otherwise, mirroring prim_chain's
+n > 128 refusal.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+#: city ids per int32 path word — MUST match branch_bound.PATH_PACK
+#: (duplicated to keep ops -> models import direction clean; pinned by
+#: tests/test_expand_pallas.py)
+PATH_PACK = 4
+
+#: compiled-mode ceiling on the aliased frontier block, in bytes. The
+#: kernel maps the whole [F, C] buffer as one block; ~16 MB VMEM/core
+#: minus working tiles leaves roughly this. Interpret mode is unlimited.
+VMEM_BUDGET_BYTES = 12 * (1 << 20)
+
+
+def _set_bit_words(n: int) -> np.ndarray:
+    """[n, W] int32 words: OR-ing row j into a visited mask visits city
+    j (same table as branch_bound._mask_consts, int32 view)."""
+    w = (n + 31) // 32
+    out = np.zeros((n, w), np.uint32)
+    out[np.arange(n), np.arange(n) // 32] = np.uint32(1) << (
+        np.arange(n) % 32
+    ).astype(np.uint32)
+    return out.view(np.int32)
+
+
+def _push_kernel(
+    nodes_ref, parents_ref, dest_ref, ccost_ref, cbound_ref, csum_ref,
+    setbit_ref, out_ref, *, n: int, pw: int, w: int, f_phys: int,
+    copy_input: bool,
+):
+    """One grid step = one popped parent: build its n candidate child
+    rows and store the pushed ones at their destination slots.
+
+    nodes_ref/out_ref: [F, C] aliased frontier buffer
+    parents_ref:       [1, C] this parent's packed row
+    dest_ref:          [1, n] absolute destination row per child
+                       (>= f_phys = pruned: not stored)
+    ccost/cbound/csum: [1, n] child float columns as int32 bit patterns
+                       (bitcast OUTSIDE the kernel, so the stored bits
+                       are exactly the reference path's)
+    setbit_ref:        [n, W] visited-mask OR table
+    ``copy_input``:    seed the output from the input ONCE, at grid
+                       step 0, so rows the push never touches persist.
+                       Required in BOTH modes: input_output_aliases
+                       aliases the HBM buffers, not the VMEM output
+                       block — without the seed, compiled copy-out
+                       would replace every un-pushed row with
+                       uninitialized VMEM contents. Under the alias the
+                       seed is an HBM->VMEM->HBM round trip of bytes
+                       that are already correct — the price of the
+                       whole-buffer-block form; the future HBM/DMA
+                       variant writes rows directly and drops it.
+    """
+    if copy_input:
+        @pl.when(pl.program_id(0) == 0)
+        def _():
+            out_ref[:] = nodes_ref[:]
+
+    row = parents_ref[0, :]
+    pathw = row[:pw]  # [pw] packed path words
+    maskw = row[pw : pw + w]  # [w] visited mask words
+    depth = row[pw + w]  # scalar int32
+    dpos = jnp.minimum(depth, n - 1)
+    wsel = dpos // PATH_PACK
+    shift = (dpos % PATH_PACK) * 8
+
+    # child path words: parent words with child c's id byte-set at the
+    # prefix position — the packed-layout analog of the reference's
+    # [k, n, n] broadcast+where, built at [n, pw] instead
+    widx = jax.lax.broadcasted_iota(jnp.int32, (n, pw), 1)
+    cities = jax.lax.broadcasted_iota(jnp.int32, (n, 1), 0)
+    pwb = jnp.broadcast_to(pathw[None, :], (n, pw))
+    neww = (pwb & ~(0xFF << shift)) | (cities << shift)
+    child_pathw = jnp.where(widx == wsel, neww, pwb)
+    child_maskw = jnp.broadcast_to(maskw[None, :], (n, w)) | setbit_ref[...]
+
+    tile = jnp.concatenate(
+        [
+            child_pathw,
+            child_maskw,
+            jnp.full((n, 1), depth + 1, jnp.int32),
+            ccost_ref[0, :][:, None],
+            cbound_ref[0, :][:, None],
+            csum_ref[0, :][:, None],
+        ],
+        axis=1,
+    )  # [n, C]
+
+    def body(c, carry):
+        dst = dest_ref[0, c]
+
+        @pl.when(dst < f_phys)
+        def _():
+            out_ref[pl.ds(dst, 1), :] = jax.lax.dynamic_slice(
+                tile, (c, 0), (1, tile.shape[1])
+            )
+
+        return carry
+
+    jax.lax.fori_loop(0, n, body, 0)
+
+
+def push_rows(
+    nodes: jnp.ndarray,
+    parents: jnp.ndarray,
+    dest: jnp.ndarray,
+    ccost: jnp.ndarray,
+    cbound: jnp.ndarray,
+    csum: jnp.ndarray,
+    n: int,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Fused in-place push: returns ``nodes`` with every child whose
+    ``dest`` row is < F written as a freshly built packed node row.
+
+    nodes:   [F, C] int32 packed frontier buffer (ALIASED in place)
+    parents: [k, C] int32 popped parent rows
+    dest:    [k, n] int32 absolute destination rows (>= F = don't push)
+    ccost/cbound/csum: [k, n] float32 child columns (bitcast to int32
+             bit patterns here — stored bits match the reference path)
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    f_phys, cols = int(nodes.shape[0]), int(nodes.shape[1])
+    k = int(parents.shape[0])
+    pw = (n + PATH_PACK - 1) // PATH_PACK
+    w = (n + 31) // 32
+    if pw + w + 4 != cols:
+        raise ValueError(
+            f"frontier row width {cols} does not match n={n} "
+            f"(expected {pw + w + 4})"
+        )
+    if not interpret and f_phys * cols * 4 > VMEM_BUDGET_BYTES:
+        # compiled mode maps the whole aliased buffer as one block; a
+        # buffer past the VMEM budget needs the (unvalidated) HBM/DMA
+        # variant — refuse loudly, as prim_chain does for n > 128
+        raise ValueError(
+            f"fused step kernel: frontier buffer {f_phys}x{cols} int32 "
+            f"({f_phys * cols * 4} bytes) exceeds the compiled VMEM "
+            f"budget ({VMEM_BUDGET_BYTES}); lower capacity/k or use "
+            "--step-kernel=reference"
+        )
+    setbit = jnp.asarray(_set_bit_words(n))
+    bits = functools.partial(jax.lax.bitcast_convert_type, new_dtype=jnp.int32)
+    # Every mode seeds the output from the input at grid step 0
+    # (copy_input in _push_kernel): the kernel writes only pushed rows,
+    # and neither interpret mode (no alias declared — its emulation of
+    # input_output_aliases on this jax 0.4.37 cannot be validated
+    # off-chip) nor compiled mode (the alias pairs the HBM buffers, not
+    # the VMEM output block) preserves untouched rows by itself. The
+    # alias is declared only when compiled, where it is load-bearing
+    # for the in-place push; the engine-level donation at the
+    # _expand_step dispatch is unaffected either way.
+    kernel = functools.partial(
+        _push_kernel, n=n, pw=pw, w=w, f_phys=f_phys, copy_input=True,
+    )
+    alias = {} if interpret else {0: 0}
+    return pl.pallas_call(
+        kernel,
+        grid=(k,),
+        in_specs=[
+            pl.BlockSpec((f_phys, cols), lambda i: (0, 0)),
+            pl.BlockSpec((1, cols), lambda i: (i, 0)),
+            pl.BlockSpec((1, n), lambda i: (i, 0)),
+            pl.BlockSpec((1, n), lambda i: (i, 0)),
+            pl.BlockSpec((1, n), lambda i: (i, 0)),
+            pl.BlockSpec((1, n), lambda i: (i, 0)),
+            pl.BlockSpec((n, w), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((f_phys, cols), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((f_phys, cols), jnp.int32),
+        input_output_aliases=alias,
+        interpret=bool(interpret),
+    )(
+        nodes, parents, dest.astype(jnp.int32), bits(ccost), bits(cbound),
+        bits(csum), setbit,
+    )

@@ -207,6 +207,15 @@ def enable(platform: Optional[str] = None) -> Optional[str]:
 
 # -- cache keys ----------------------------------------------------------------
 
+#: frontier node-row layout version. CANONICAL here (the engine
+#: re-exports it: models.branch_bound.FRONTIER_LAYOUT_VERSION) so the
+#: AOT key can carry it without a perf -> models import cycle. v2 =
+#: int8-packed tour prefix (ISSUE 8); bump on ANY packed-row layout
+#: change — a stale executable compiled for a previous layout would
+#: read garbage columns from a donated buffer.
+FRONTIER_LAYOUT_VERSION = 2
+
+
 
 def _leaf_sig(x: Any) -> str:
     shape = tuple(getattr(x, "shape", np.shape(x)))
@@ -241,6 +250,7 @@ def entry_key(
     leaves = jax.tree_util.tree_leaves(args)
     parts = [
         "v1",
+        f"layout{FRONTIER_LAYOUT_VERSION}",
         name,
         jax_version,
         backend,

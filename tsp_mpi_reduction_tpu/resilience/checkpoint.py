@@ -78,15 +78,26 @@ def _checksum(payload: bytes) -> str:
     return hashlib.blake2b(payload, digest_size=16).hexdigest()
 
 
-def pack(payload: bytes, fingerprint: Optional[str] = None) -> bytes:
-    header = json.dumps(
+def pack(
+    payload: bytes,
+    fingerprint: Optional[str] = None,
+    extra_header: Optional[Dict] = None,
+) -> bytes:
+    """``extra_header``: additional JSON-serializable header keys (e.g.
+    the engine's ``frontier_layout`` version). Purely informational —
+    readers ignore keys they don't know, so old snapshots (without them)
+    and old readers (seeing new ones) both keep working; the reserved
+    integrity keys above cannot be overridden."""
+    header_dict = dict(extra_header or {})
+    header_dict.update(
         {
             "version": FORMAT_VERSION,
             "fingerprint": fingerprint,
             "payload_len": len(payload),
             "checksum": _checksum(payload),
         }
-    ).encode()
+    )
+    header = json.dumps(header_dict).encode()
     return MAGIC + struct.pack(">I", len(header)) + header + payload
 
 
@@ -160,12 +171,13 @@ def write_atomic(
     *,
     fingerprint: Optional[str] = None,
     keep: Optional[int] = None,
+    extra_header: Optional[Dict] = None,
 ) -> None:
     """Publish a snapshot crash-safely: temp file + fsync + rotation shift
     + ``os.replace``. The previous ``keep - 1`` good snapshots survive as
-    ``path.1 ... path.{keep-1}``."""
+    ``path.1 ... path.{keep-1}``. ``extra_header``: see :func:`pack`."""
     keep = default_keep() if keep is None else max(1, keep)
-    blob = pack(payload, fingerprint)
+    blob = pack(payload, fingerprint, extra_header)
     blob, injected = registry().filter_bytes("ckpt.write", blob)
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
