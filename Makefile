@@ -14,12 +14,12 @@ PY ?= python
 CXX ?= g++
 CXXFLAGS ?= -O2 -std=c++11
 
-.PHONY: all lint chaos native oracle test test-fast bench bench-serve bench-faults bench-compile bench-obs bench-step run sweep goldens clean
+.PHONY: all lint chaos native oracle test test-fast bench bench-serve bench-faults bench-compile bench-obs bench-step bench-check run sweep goldens clean
 
-all: lint native oracle chaos
+all: lint native oracle chaos bench-check
 
 # --- static analysis: one gate, two passes against ONE shared baseline —
-# graftlint (syntactic AST rules R1-R8) + graftflow (interprocedural
+# graftlint (syntactic AST rules R1-R8 + R13) + graftflow (interprocedural
 # dataflow rules R9-R12: lock-discipline races, use-after-donate,
 # static-arg recompile risk, shard_map axis-name drift; see README). The
 # CLI runs both and FAILS on new findings of either pass and on dead
@@ -97,6 +97,15 @@ bench-step:
 # -> BENCH_OBS.json
 bench-obs:
 	TSP_BENCH=obs $(PY) bench.py
+
+# regression sentinel over bench_history.jsonl (ISSUE 9): every TSP_BENCH
+# run appends a fingerprinted record; this gate fails when a governed
+# metric's newest sample is worse than its history allows (median + MAD
+# model, per-metric direction/threshold — obs/bench_history.py). Chained
+# into the default target; tolerant below min-samples, so a fresh clone
+# passes while the history accretes.
+bench-check:
+	$(PY) tools/bench_check.py
 
 # reference `make run` analog: same config, 3-rank-shaped merge tree
 run:

@@ -8,9 +8,16 @@ oracle parity is checked bit-for-bit against the C++ double-precision
 reference (the TPU speed path, by contrast, runs float32).
 """
 
-import jax
+import os
 
-from tsp_mpi_reduction_tpu.utils.backend import force_host_platform
+# tests exercise the bench helpers in-process; their runs must never
+# append to the repo's real bench_history.jsonl (ISSUE 9) — tests that
+# test the history layer point TSP_BENCH_HISTORY at a tmp path themselves
+os.environ.setdefault("TSP_BENCH_HISTORY", "off")
+
+import jax  # noqa: E402
+
+from tsp_mpi_reduction_tpu.utils.backend import force_host_platform  # noqa: E402
 
 force_host_platform(8)
 jax.config.update("jax_enable_x64", True)

@@ -1196,7 +1196,7 @@ def test_sarif_clean_run_emits_empty_results(tmp_path):
     doc = json.loads(sarif_path.read_text())
     assert doc["runs"][0]["results"] == []
     # rule catalog is stable even when clean (CI trend lines)
-    assert len(doc["runs"][0]["tool"]["driver"]["rules"]) == 12
+    assert len(doc["runs"][0]["tool"]["driver"]["rules"]) == 13
 
 
 # -- the repo gate + latency budget --------------------------------------------

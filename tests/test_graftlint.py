@@ -1232,3 +1232,139 @@ def test_r8_repo_is_clean():
         rules={"R8"},
     )
     assert vs == [], [v.render() for v in vs]
+
+
+# ---------------------------------------------------------------------------
+# R13: unbounded metric-label cardinality (ISSUE 9 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_r13_flags_fstring_label():
+    vs = lint(
+        """
+        from tsp_mpi_reduction_tpu.obs.metrics import REGISTRY
+
+        def record(user):
+            REGISTRY.inc("requests_total", who=f"user-{user}")
+        """,
+        rules={"R13"},
+    )
+    assert rules_of(vs) == ["R13"] and "f-string" in vs[0].message
+
+
+def test_r13_flags_loop_variable_label():
+    vs = lint(
+        """
+        from tsp_mpi_reduction_tpu.obs.metrics import REGISTRY
+
+        def record(items):
+            for item in items:
+                REGISTRY.inc("seen_total", kind=item)
+        """,
+        rules={"R13"},
+    )
+    assert rules_of(vs) == ["R13"] and "loop variable" in vs[0].message
+
+
+def test_r13_flags_per_request_field_label():
+    vs = lint(
+        """
+        from tsp_mpi_reduction_tpu.obs.metrics import REGISTRY
+
+        def handle(request, req):
+            REGISTRY.observe("latency_seconds", 0.1, rid=request["id"])
+            REGISTRY.set_gauge("g", 1.0, src=str(req.get("src")))
+        """,
+        rules={"R13"},
+    )
+    assert rules_of(vs) == ["R13"] and len(vs) == 2
+    assert "per-request" in vs[0].message
+
+
+def test_r13_loop_variable_scope_ends_with_the_loop():
+    # after the loop body, the name is an ordinary local again — and a
+    # nested def starts a fresh loop-target scope
+    vs = lint(
+        """
+        from tsp_mpi_reduction_tpu.obs.metrics import REGISTRY
+
+        def record(items):
+            for item in items:
+                pass
+            item = "fixed"
+            REGISTRY.inc("seen_total", kind=item)
+
+        def outer(rows):
+            for row in rows:
+                def inner():
+                    REGISTRY.inc("x_total", row="literal-arg-name")
+        """,
+        rules={"R13"},
+    )
+    assert rules_of(vs) == []
+
+
+def test_r13_quiet_on_bounded_labels():
+    vs = lint(
+        """
+        from tsp_mpi_reduction_tpu.obs.metrics import REGISTRY
+
+        TIER = "bnb"
+
+        def fold(entry, outcome):
+            REGISTRY.inc("outcomes_total", entry=entry, outcome=outcome)
+            REGISTRY.inc("answers_total", tier=TIER)
+            REGISTRY.observe("seconds", 1.5, phase="compile")
+            # the variable part belongs in the VALUE, not a label
+            REGISTRY.inc("bytes_total", 4096, direction="to_host")
+            for seam in ("a", "b"):
+                OTHER.fire(seam=seam)  # non-registry receivers exempt
+        """,
+        rules={"R13"},
+    )
+    assert rules_of(vs) == []
+
+
+def test_r13_value_kwarg_is_not_a_label():
+    vs = lint(
+        """
+        from tsp_mpi_reduction_tpu.obs.metrics import REGISTRY
+
+        def record(req):
+            REGISTRY.inc("elapsed_total", value=req["elapsed_ms"])
+        """,
+        rules={"R13"},
+    )
+    assert rules_of(vs) == []
+
+
+def test_r13_inline_disable_honored():
+    vs = lint(
+        """
+        from tsp_mpi_reduction_tpu.obs.metrics import REGISTRY
+
+        def record(request):
+            REGISTRY.inc("x_total", rid=request["id"])  # graftlint: disable=R13
+        """,
+        rules={"R13"},
+    )
+    assert rules_of(vs) == []
+
+
+def test_r13_repo_is_clean():
+    """Every registry call site in the shipped package labels from fixed
+    sets (tier/entry/seam/phase names) — R13 lints clean at zero
+    baseline entries."""
+    import pathlib
+
+    from tsp_mpi_reduction_tpu.analysis.__main__ import (
+        _DEFAULT_TARGETS,
+        _REPO_ROOT,
+    )
+
+    vs = graftlint.lint_paths(
+        [pathlib.Path(p) for p in _DEFAULT_TARGETS if pathlib.Path(p).exists()],
+        root=_REPO_ROOT,
+        rules={"R13"},
+    )
+    assert vs == [], [v.render() for v in vs]

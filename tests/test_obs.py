@@ -360,7 +360,7 @@ SERVICE_STATS_SCHEMA = {
     "responses": int, "errors": int, "deadline_misses": int,
     "refreshes": int, "rung_failures": dict, "tiers": dict, "cache": dict,
     "scheduler": dict, "phases_s": dict, "health": dict,
-    "compile_cache": dict, "obs": dict,
+    "compile_cache": dict, "slo": dict, "obs": dict,
 }
 
 BNB_PAYLOAD_SCHEMA = {
@@ -373,7 +373,7 @@ BNB_PAYLOAD_SCHEMA = {
     "lower_bound": float, "lb_certified": float, "spill_rounds": int,
     "spill_events": int, "spill_full_merges": int, "spill_bytes_to_host": int,
     "spill_bytes_to_device": int, "health": dict, "compile_cache": dict,
-    "series": dict, "obs": dict,
+    "series": dict, "anomalies": dict, "obs": dict,
 }
 
 

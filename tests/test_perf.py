@@ -141,6 +141,10 @@ def test_aot_store_corrupt_file_degrades_to_compile(perf_dir):
     exec_path, _meta, _uns = cc._aot_paths(key)
     with open(exec_path, "wb") as fh:
         fh.write(b"garbage")
+    # the corrupt-store scenario is a WARM PROCESS reading a torn disk
+    # entry — drop the in-process executable memo so the load path
+    # actually re-reads the file (the memo otherwise never touches disk)
+    cc._AOT_LOADED.clear()
     before = cc.STATS.snapshot()
     c = cc.aot_load_or_compile("toy3", f, (x,), {"k": 2})
     after = cc.STATS.snapshot()

@@ -1,0 +1,152 @@
+"""Bench-history regression gate + shared history appender (ISSUE 9).
+
+Two subcommands (default: ``check``):
+
+``check``   read ``bench_history.jsonl``, evaluate every governed metric's
+            newest sample against its prior samples (median + MAD model,
+            per-metric direction/threshold/min-samples — see
+            ``obs.bench_history``), print a verdict table, exit 1 on any
+            regression. Below min-samples a metric reports
+            ``insufficient`` and never fails — a fresh clone passes while
+            history accretes. ``make bench-check`` runs this and the
+            default ``make`` chains it, so a slowdown fails the build
+            instead of aging invisibly in a BENCH_*.json.
+
+``append``  turn an existing ``BENCH_*.json`` artifact into one history
+            record and append it through the same locked atomic appender
+            the in-process benches use — this is how ``tools/tpu_bench.sh``
+            joins TPU-grant captures to the same history as CPU runs.
+
+Usage:
+    python tools/bench_check.py [check] [--history PATH] [--rules RULES.json] [--json]
+    python tools/bench_check.py append BENCH_OBS.json --mode obs [--backend tpu]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from tsp_mpi_reduction_tpu.obs import bench_history as bh  # noqa: E402
+
+
+def _default_history() -> str:
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return bh.resolve_history_path(repo_root) or os.path.join(
+        repo_root, bh.DEFAULT_PATH
+    )
+
+
+def run_check(history: str, rules_path: Optional[str], as_json: bool) -> int:
+    rules = bh.load_rules(rules_path) if rules_path else None
+    records = bh.read(history)
+    verdicts = bh.check(records, rules)
+    regressions = [v for v in verdicts if v.status == "regression"]
+    if as_json:
+        print(json.dumps({
+            "history": history,
+            "records": len(records),
+            "verdicts": [v.as_dict() for v in verdicts],
+            "regressions": len(regressions),
+            "ok": not regressions,
+        }))
+        return 1 if regressions else 0
+    if not records:
+        print(
+            f"bench-check: no history at {history} — nothing to gate "
+            "(run any TSP_BENCH=* bench to start one)"
+        )
+        return 0
+    print(f"bench-check: {len(records)} records in {history}")
+    status_mark = {"ok": "ok ", "regression": "FAIL", "insufficient": "n/a ",
+                   "no_value": "n/a "}
+    for v in verdicts:
+        print(
+            f"  [{status_mark.get(v.status, '?')}] {v.metric} "
+            f"({v.group}, {v.samples} samples): {v.detail or v.status}"
+        )
+    if regressions:
+        print(
+            f"bench-check: {len(regressions)} regression(s) — the newest "
+            "sample is worse than its history allows; investigate before "
+            "shipping (or re-run the bench if the machine was loaded)"
+        )
+        return 1
+    print("bench-check: no regressions")
+    return 0
+
+
+def run_append(
+    artifact_path: str, mode: str, history: str, backend: Optional[str]
+) -> int:
+    try:
+        with open(artifact_path, encoding="utf-8") as fh:
+            artifact = json.load(fh)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"error: cannot read artifact {artifact_path!r}: {e}",
+              file=sys.stderr)
+        return 2
+    if not isinstance(artifact, dict) or artifact.get("metric") is None:
+        print(
+            f"error: {artifact_path!r} has no 'metric' headline — not a "
+            "bench artifact", file=sys.stderr,
+        )
+        return 2
+    record = bh.make_record(
+        mode, artifact,
+        config={"artifact": os.path.basename(artifact_path)},
+        backend=backend or "unknown",
+    )
+    bh.append(history, record)
+    print(f"appended {artifact['metric']}={artifact.get('value')} -> {history}")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # default subcommand: bare invocation == check
+    if not argv or argv[0].startswith("-"):
+        argv.insert(0, "check")
+    ap = argparse.ArgumentParser(
+        description="bench-history regression gate / history appender"
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    chk = sub.add_parser("check", help="gate on the history (default)")
+    chk.add_argument("--history", default=None, metavar="PATH")
+    chk.add_argument("--rules", default=None, metavar="RULES.json",
+                     help="per-metric overrides merged over the defaults")
+    chk.add_argument("--json", action="store_true", dest="as_json")
+    app = sub.add_parser("append", help="append a BENCH_*.json artifact")
+    app.add_argument("artifact")
+    app.add_argument("--mode", required=True,
+                     help="bench mode that produced the artifact (bnb/serve/...)")
+    app.add_argument("--history", default=None, metavar="PATH")
+    app.add_argument("--backend", default=None,
+                     help="backend label (tpu_bench.sh passes tpu)")
+    args = ap.parse_args(argv)
+    if args.cmd == "append":
+        if args.history is None:
+            repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+            resolved = bh.resolve_history_path(repo_root)
+            if resolved is None:
+                # TSP_BENCH_HISTORY=off is the WRITE kill switch: it must
+                # silence this append path exactly like the in-process
+                # bench appends (check below still gates the existing
+                # file — off disables appending, not gating)
+                print("history disabled (TSP_BENCH_HISTORY=off): append skipped")
+                return 0
+            history = resolved
+        else:
+            history = args.history
+        return run_append(args.artifact, args.mode, history, args.backend)
+    history = args.history or _default_history()
+    return run_check(history, args.rules, args.as_json)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

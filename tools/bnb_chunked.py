@@ -28,6 +28,8 @@ import time
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
+from tsp_mpi_reduction_tpu.obs import tracing as _tracing  # noqa: E402
+
 
 def _ckpt_candidates(ckpt_path: str) -> list:
     """Existing snapshots in the rotation chain, newest first. The gate
@@ -135,6 +137,20 @@ def main() -> int:
     last = None
     lb_history: list = []
     stalled = False
+    # ONE campaign = ONE span tree (ISSUE 9): the campaign root opens here
+    # (itself under TSP_TRACE_PARENT, so campaigns nest under a caller's
+    # trace too), each chunk attempt gets a child span in THIS process,
+    # and every chunk subprocess inherits that chunk span's context via
+    # its env — its bnb.solve root (compile/aot_load phases, fault events,
+    # fallback restores included) then attaches instead of orphaning.
+    # All spans land in the same TSP_TRACE JSONL (append mode); with no
+    # sink configured every span here is the shared no-op.
+    campaign_cm = _tracing.span(
+        "bnb.campaign",
+        parent=_tracing.parent_from_env(),
+        instance=args.instance,
+        max_chunks=args.max_chunks,
+    )
     #: per-chunk compile attribution (obs registry entry labels): each
     #: chunk process reports its OWN compile/aot-load seconds, so the
     #: summary can show which chunk paid the compile and which warmed
@@ -152,138 +168,152 @@ def main() -> int:
             os.path.dirname(os.path.abspath(ckpt_real)) or ".",
             "compile_cache",
         )
-    for chunk in range(1, args.max_chunks + 1):
-        line = None
-        # a failed attempt is re-run, not fatal: the crash-safe store
-        # guarantees the checkpoint on disk is the newest VALID snapshot
-        # (rotation fallback), so the retry resumes where the crash left
-        # recoverable state — cmd is rebuilt per attempt because the
-        # first crash may have just created the checkpoint to resume
-        for attempt in range(args.chunk_retries + 1):
-            # a retry must never overrun the CAMPAIGN wall budget: a hung
-            # chunk already burned up to chunk_timeout, so both the
-            # bail-out and the subprocess cap track the remaining budget
-            chunk_cap = args.chunk_timeout
-            if args.time_limit is not None:
-                remaining = args.time_limit - (time.perf_counter() - t0)
-                if remaining <= 0:
-                    print(
-                        f"chunk {chunk}: wall budget exhausted "
-                        "(no retry attempted)", file=sys.stderr,
-                    )
-                    break
-                chunk_cap = min(chunk_cap, remaining + 30.0)  # grace: JSON flush
-            cmd = [
-                sys.executable, tool, args.instance,
-                "--device-loop=on", f"--max-iters={args.chunk_iters}",
-                f"--checkpoint={ckpt}",
-            ]
-            if _ckpt_candidates(ckpt_real):
-                # the store's restore falls back through the rotation
-                # chain, so --resume is right even when the primary file
-                # itself was lost to a mid-rotation crash
-                cmd.append(f"--resume={ckpt}")
-            if args.time_limit is not None:
-                # remaining wall budget is enforced inside the chunk too
-                # (coarsely: between its device dispatches)
-                cmd.append(f"--time-limit={max(remaining, 1.0)}")
-            cmd += passthrough
-            retry_note = (
-                f" — retrying ({attempt + 1}/{args.chunk_retries})"
-                if attempt < args.chunk_retries
-                else ""
-            )
-            try:
-                r = subprocess.run(
-                    cmd, capture_output=True, text=True,
-                    timeout=chunk_cap, env=child_env,
+    with campaign_cm as campaign:
+        for chunk in range(1, args.max_chunks + 1):
+            line = None
+            # a failed attempt is re-run, not fatal: the crash-safe store
+            # guarantees the checkpoint on disk is the newest VALID snapshot
+            # (rotation fallback), so the retry resumes where the crash left
+            # recoverable state — cmd is rebuilt per attempt because the
+            # first crash may have just created the checkpoint to resume
+            for attempt in range(args.chunk_retries + 1):
+                # a retry must never overrun the CAMPAIGN wall budget: a hung
+                # chunk already burned up to chunk_timeout, so both the
+                # bail-out and the subprocess cap track the remaining budget
+                chunk_cap = args.chunk_timeout
+                if args.time_limit is not None:
+                    remaining = args.time_limit - (time.perf_counter() - t0)
+                    if remaining <= 0:
+                        print(
+                            f"chunk {chunk}: wall budget exhausted "
+                            "(no retry attempted)", file=sys.stderr,
+                        )
+                        break
+                    chunk_cap = min(chunk_cap, remaining + 30.0)  # grace: JSON flush
+                cmd = [
+                    sys.executable, tool, args.instance,
+                    "--device-loop=on", f"--max-iters={args.chunk_iters}",
+                    f"--checkpoint={ckpt}",
+                ]
+                if _ckpt_candidates(ckpt_real):
+                    # the store's restore falls back through the rotation
+                    # chain, so --resume is right even when the primary file
+                    # itself was lost to a mid-rotation crash
+                    cmd.append(f"--resume={ckpt}")
+                if args.time_limit is not None:
+                    # remaining wall budget is enforced inside the chunk too
+                    # (coarsely: between its device dispatches)
+                    cmd.append(f"--time-limit={max(remaining, 1.0)}")
+                cmd += passthrough
+                retry_note = (
+                    f" — retrying ({attempt + 1}/{args.chunk_retries})"
+                    if attempt < args.chunk_retries
+                    else ""
                 )
-            except subprocess.TimeoutExpired:
+                # one span per ATTEMPT (a retried chunk shows both tries
+                # in the tree); its context rides the child's env so the
+                # subprocess's bnb.solve root attaches under it
+                with _tracing.span(
+                    "campaign.chunk", chunk=chunk, attempt=attempt
+                ) as csp:
+                    parent_token = _tracing.format_parent(csp.context)
+                    if parent_token is not None:
+                        child_env[_tracing.ENV_PARENT] = parent_token
+                    try:
+                        r = subprocess.run(
+                            cmd, capture_output=True, text=True,
+                            timeout=chunk_cap, env=child_env,
+                        )
+                    except subprocess.TimeoutExpired:
+                        csp.set("timeout_s", round(chunk_cap, 1))
+                        csp.event("chunk_timeout")
+                        print(
+                            f"chunk {chunk}: timed out after "
+                            f"{chunk_cap:.0f}s{retry_note}",
+                            file=sys.stderr,
+                        )
+                        continue
+                    csp.set("rc", r.returncode)
+                sys.stderr.write(r.stderr[-2000:])
+                out = r.stdout.strip().splitlines()[-1] if r.stdout.strip() else ""
+                if r.returncode == 0 and out.startswith("{"):
+                    line = out
+                    break
+                campaign.event("chunk_retry", chunk=chunk, rc=r.returncode)
                 print(
-                    f"chunk {chunk}: timed out after "
-                    f"{chunk_cap:.0f}s{retry_note}",
+                    f"chunk {chunk}: solver failed rc={r.returncode}{retry_note}",
                     file=sys.stderr,
                 )
-                continue
-            sys.stderr.write(r.stderr[-2000:])
-            out = r.stdout.strip().splitlines()[-1] if r.stdout.strip() else ""
-            if r.returncode == 0 and out.startswith("{"):
-                line = out
-                break
-            print(
-                f"chunk {chunk}: solver failed rc={r.returncode}{retry_note}",
-                file=sys.stderr,
+            if line is None:
+                return 1
+            last = json.loads(line)
+            print(line)
+            compile_by_chunk.append(
+                (last.get("obs") or {}).get("compile_phases_s") or {}
             )
-        if line is None:
-            return 1
-        last = json.loads(line)
-        print(line)
-        compile_by_chunk.append(
-            (last.get("obs") or {}).get("compile_phases_s") or {}
-        )
-        # a chunk just ran on the backend — later chunks skip the
-        # accelerator probe subprocess (each probe is a full jax import
-        # plus a chip claim/release cycle: wasted wall and extra exposure
-        # to the grant-forfeit failure mode). A mid-run grant lapse is
-        # still bounded by --chunk-timeout.
-        child_env["TSP_BACKEND_PROBED"] = "1"
-        elapsed = time.perf_counter() - t0
-        if last["proven_optimal"]:
-            break
-        if args.time_limit is not None and elapsed > args.time_limit:
-            break
-        # stall detection tracks the CERTIFIED (monotone) LB: the engine
-        # clamps it to the running max carried through the checkpoint, so
-        # a chunk whose raw min-over-open regresses (VERDICT r5) can no
-        # longer fake negative progress and trip the stall rule early
-        lb_cert = last.get("lb_certified", last["lower_bound"])
-        if args.lb_stall_gain is not None and lb_cert is not None:
-            lb_history.append(float(lb_cert))
-            w = args.lb_stall_chunks
-            if (
-                len(lb_history) > w
-                and lb_history[-1] - lb_history[-1 - w]
-                < args.lb_stall_gain * w
-            ):
-                stalled = True
-                print(
-                    f"chunk {chunk}: LB climb flattened "
-                    f"(+{lb_history[-1] - lb_history[-1 - w]:.2f} over the "
-                    f"last {w} chunks < {args.lb_stall_gain}/chunk) — "
-                    "stopping at exhaustion", file=sys.stderr,
-                )
+            # a chunk just ran on the backend — later chunks skip the
+            # accelerator probe subprocess (each probe is a full jax import
+            # plus a chip claim/release cycle: wasted wall and extra exposure
+            # to the grant-forfeit failure mode). A mid-run grant lapse is
+            # still bounded by --chunk-timeout.
+            child_env["TSP_BACKEND_PROBED"] = "1"
+            elapsed = time.perf_counter() - t0
+            if last["proven_optimal"]:
                 break
-    assert last is not None
-    # defense in depth: the engine already clamps, but the summary's
-    # certified LB is additionally the max over every chunk it saw
-    lb_final = last.get("lb_certified", last["lower_bound"])
-    if lb_history:
-        lb_final = max([lb_final] + lb_history) if lb_final is not None else max(lb_history)
-    print(json.dumps({
-        "summary": True,
-        "instance": last["instance"],
-        "chunks": chunk,
-        "cost": last["cost"],
-        "proven_optimal": last["proven_optimal"],
-        "lower_bound": lb_final,
-        "lb_raw": last.get("lb_raw"),
-        "lb_certified": lb_final,
-        "gap": (
-            round(last["cost"] - lb_final, 3) if lb_final is not None else None
-        ),
-        "lb_stalled": stalled,
-        "total_wall_s": round(time.perf_counter() - t0, 1),
-        # compile cost attributed per chunk process (entry-labeled obs
-        # registry series, satellite of ISSUE 6): chunk 1 pays, the
-        # warm-start chunks show aot_load-only seconds
-        "compile_s_by_chunk": compile_by_chunk,
-        "compile_s_total": {
-            entry: round(sum(c.get(entry, {}).get(ph, 0.0)
-                             for c in compile_by_chunk
-                             for ph in c.get(entry, {})), 4)
-            for entry in {e for c in compile_by_chunk for e in c}
-        },
-    }))
+            if args.time_limit is not None and elapsed > args.time_limit:
+                break
+            # stall detection tracks the CERTIFIED (monotone) LB: the engine
+            # clamps it to the running max carried through the checkpoint, so
+            # a chunk whose raw min-over-open regresses (VERDICT r5) can no
+            # longer fake negative progress and trip the stall rule early
+            lb_cert = last.get("lb_certified", last["lower_bound"])
+            if args.lb_stall_gain is not None and lb_cert is not None:
+                lb_history.append(float(lb_cert))
+                w = args.lb_stall_chunks
+                if (
+                    len(lb_history) > w
+                    and lb_history[-1] - lb_history[-1 - w]
+                    < args.lb_stall_gain * w
+                ):
+                    stalled = True
+                    print(
+                        f"chunk {chunk}: LB climb flattened "
+                        f"(+{lb_history[-1] - lb_history[-1 - w]:.2f} over the "
+                        f"last {w} chunks < {args.lb_stall_gain}/chunk) — "
+                        "stopping at exhaustion", file=sys.stderr,
+                    )
+                    break
+        assert last is not None
+        # defense in depth: the engine already clamps, but the summary's
+        # certified LB is additionally the max over every chunk it saw
+        lb_final = last.get("lb_certified", last["lower_bound"])
+        if lb_history:
+            lb_final = max([lb_final] + lb_history) if lb_final is not None else max(lb_history)
+        print(json.dumps({
+            "summary": True,
+            "instance": last["instance"],
+            "chunks": chunk,
+            "cost": last["cost"],
+            "proven_optimal": last["proven_optimal"],
+            "lower_bound": lb_final,
+            "lb_raw": last.get("lb_raw"),
+            "lb_certified": lb_final,
+            "gap": (
+                round(last["cost"] - lb_final, 3) if lb_final is not None else None
+            ),
+            "lb_stalled": stalled,
+            "total_wall_s": round(time.perf_counter() - t0, 1),
+            # compile cost attributed per chunk process (entry-labeled obs
+            # registry series, satellite of ISSUE 6): chunk 1 pays, the
+            # warm-start chunks show aot_load-only seconds
+            "compile_s_by_chunk": compile_by_chunk,
+            "compile_s_total": {
+                entry: round(sum(c.get(entry, {}).get(ph, 0.0)
+                                 for c in compile_by_chunk
+                                 for ph in c.get(entry, {})), 4)
+                for entry in {e for c in compile_by_chunk for e in c}
+            },
+        }))
     return 0
 
 

@@ -104,6 +104,10 @@ def result_payload(res, inst, args) -> dict:
         # frontier occupancy, spill bytes, incumbent/LB-floor
         # trajectory; null under TSP_OBS=off
         "series": res.series,
+        # stall-sentinel verdicts (obs.anomaly): nodes/sec collapse,
+        # certified-LB stagnation — each was also fired as a health
+        # event at detection time; null under TSP_OBS=off
+        "anomalies": res.anomalies,
         # obs layer provenance: trace sink (TSP_TRACE), enabled flag,
         # per-entry compile-phase attribution from the metrics registry
         "obs": _reporting.obs_block(trace_path=_tracing.TRACER.path),
@@ -214,9 +218,16 @@ def main() -> int:
     d = inst.distance_matrix()
 
     # one root span per solve when a trace sink is configured
-    # (TSP_TRACE=path.jsonl): chunked campaigns then leave one span per
-    # chunk process in a shared JSONL, renderable by tools/obs_report.py
-    with _tracing.span("bnb.solve", instance=inst.name, ranks=args.ranks):
+    # (TSP_TRACE=path.jsonl). Under a TSP_TRACE_PARENT stamp (the chunked
+    # driver sets one per chunk subprocess) this root attaches to the
+    # campaign's span tree instead of starting a trace island — one
+    # campaign, one tree, compile phases and fault events included
+    with _tracing.span(
+        "bnb.solve",
+        parent=_tracing.parent_from_env(),
+        instance=inst.name,
+        ranks=args.ranks,
+    ):
         if args.ranks > 1:
             from tsp_mpi_reduction_tpu.parallel.mesh import make_rank_mesh
 

@@ -1,6 +1,6 @@
 #!/usr/bin/env python
 """CI lint digest: per-rule counts + baseline deltas for the combined
-graftlint (R1-R8) + graftflow (R9-R12) run.
+graftlint (R1-R8, R13) + graftflow (R9-R12) run.
 
 ``make lint`` already fails the build on new findings; this tool exists
 for the CI LOG — one table a human can read in the job output (and one

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from typing import Dict, List, Optional
 
@@ -69,12 +70,27 @@ def _render_node(node: Dict, depth: int, out: List[str]) -> None:
         _render_node(child, depth + 1, out)
 
 
-def render_trace(path: str, limit: Optional[int] = None) -> str:
-    spans = tracing.read_trace(path)
+def render_trace(paths, limit: Optional[int] = None) -> str:
+    """Render one or more trace JSONL sinks as span trees. Several paths
+    are STITCHED before reconstruction (ISSUE 9: a chunked campaign's
+    parent + chunk subprocesses may leave spans across files — the union
+    reconstructs as one tree per trace_id, exactly like a single file)."""
+    if isinstance(paths, str):
+        paths = [paths]
+    for p in paths:
+        # every path here was EXPLICITLY named by the caller — a typo'd
+        # or never-created sink must be an error, not a healthy-looking
+        # "0 spans, 0 orphans" (read_traces' skip-unreadable lenience is
+        # for programmatic stitching, where sinks may legitimately be
+        # partial)
+        if not os.path.exists(p):
+            raise OSError(f"trace sink not found: {p!r}")
+    spans = tracing.read_traces(list(paths))
     trees = tracing.build_trees(spans)
     orphans = tracing.orphan_spans(spans)
+    label = ", ".join(paths)
     out: List[str] = [
-        f"== trace {path}: {len(spans)} spans, {len(trees)} traces, "
+        f"== trace {label}: {len(spans)} spans, {len(trees)} traces, "
         f"{len(orphans)} orphans =="
     ]
     items = sorted(
@@ -157,7 +173,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         description="render obs trace/series/metrics artifacts as text"
     )
-    ap.add_argument("--trace", default=None, help="span JSONL path")
+    ap.add_argument("--trace", default=None, action="append",
+                    help="span JSONL path (repeatable: several sinks are "
+                    "stitched into one reconstruction — multi-file "
+                    "campaign traces)")
     ap.add_argument("--series", default=None,
                     help="bnb_solve JSON (line file ok) with a series block")
     ap.add_argument("--metrics", default=None, help="/metrics.json dump")

@@ -42,26 +42,32 @@ fi
 if [ ! -s BENCH_STEP_FUSED_TPU.json ]; then
     echo "== r6 fused-vs-reference expansion step (ISSUE 8, compiled Pallas) =="
     TSP_BENCH=step TSP_BENCH_STEP_OUT=BENCH_STEP_FUSED_TPU.json \
-        python bench.py 2> >(tail -3 >&2) || true
+        TSP_BENCH_HISTORY=off python bench.py 2> >(tail -3 >&2) || true
     [ -s BENCH_STEP_FUSED_TPU.json ] || rm -f BENCH_STEP_FUSED_TPU.json
+    # TPU captures join the same bench history as CPU runs (ISSUE 9):
+    # one fingerprinted record through the shared locked appender
+    [ -s BENCH_STEP_FUSED_TPU.json ] && python tools/bench_check.py \
+        append BENCH_STEP_FUSED_TPU.json --mode step --backend tpu || true
 fi
 
 if [ ! -s BENCH_BNB_TPU_R5.json ]; then
     echo "== r5 B&B eil51 recapture (north-star metric, final engine) =="
-    TSP_BENCH=bnb python bench.py 2> >(tail -3 >&2) | tee BENCH_BNB_TPU_R5.json
+    TSP_BENCH=bnb TSP_BENCH_HISTORY=off python bench.py 2> >(tail -3 >&2) | tee BENCH_BNB_TPU_R5.json
     [ -s BENCH_BNB_TPU_R5.json ] || rm -f BENCH_BNB_TPU_R5.json
+    [ -s BENCH_BNB_TPU_R5.json ] && python tools/bench_check.py \
+        append BENCH_BNB_TPU_R5.json --mode bnb --backend tpu || true
 fi
 
 if [ ! -s BENCH_BNB_TPU_R5_NOSORT.json ]; then
     echo "== r5 B&B eil51, natural push order (sort-free step A/B) =="
-    TSP_BENCH=bnb TSP_BENCH_PUSH_ORDER=natural python bench.py \
+    TSP_BENCH=bnb TSP_BENCH_PUSH_ORDER=natural TSP_BENCH_HISTORY=off python bench.py \
         2> >(tail -3 >&2) | tee BENCH_BNB_TPU_R5_NOSORT.json
     [ -s BENCH_BNB_TPU_R5_NOSORT.json ] || rm -f BENCH_BNB_TPU_R5_NOSORT.json
 fi
 
 if [ ! -s BENCH_BNB_TPU_R5_CAPPED.json ]; then
     echo "== r5 B&B eil51, capped push block (scatter v4, engine A/B) =="
-    TSP_BENCH=bnb TSP_BENCH_PUSH_BLOCK=4096 python bench.py \
+    TSP_BENCH=bnb TSP_BENCH_PUSH_BLOCK=4096 TSP_BENCH_HISTORY=off python bench.py \
         2> >(tail -3 >&2) | tee BENCH_BNB_TPU_R5_CAPPED.json
     [ -s BENCH_BNB_TPU_R5_CAPPED.json ] || rm -f BENCH_BNB_TPU_R5_CAPPED.json
 fi
@@ -77,7 +83,7 @@ if [ ! -s BENCH_BNB_TPU_R5_COMBO.json ]; then
     # Captured so an unattended grant records the likely-best config
     # even before any interactive tuning session.
     echo "== r5 B&B eil51, combo (k=256 + capped push block) =="
-    TSP_BENCH=bnb TSP_BENCH_K=256 TSP_BENCH_PUSH_BLOCK=1024 python bench.py \
+    TSP_BENCH=bnb TSP_BENCH_K=256 TSP_BENCH_PUSH_BLOCK=1024 TSP_BENCH_HISTORY=off python bench.py \
         2> >(tail -3 >&2) | tee BENCH_BNB_TPU_R5_COMBO.json
     [ -s BENCH_BNB_TPU_R5_COMBO.json ] || rm -f BENCH_BNB_TPU_R5_COMBO.json
 fi
@@ -86,7 +92,7 @@ if [ "$(wc -l < BENCH_BNB_TPU_KSWEEP_R5.jsonl 2>/dev/null || echo 0)" -lt 4 ]; t
     echo "== r5 B&B eil51 k-sweep =="
     : > BENCH_BNB_TPU_KSWEEP_R5.tmp
     for K in 128 256 512 2048; do
-        TSP_BENCH=bnb TSP_BENCH_K=$K python bench.py 2> >(tail -2 >&2) \
+        TSP_BENCH=bnb TSP_BENCH_K=$K TSP_BENCH_HISTORY=off python bench.py 2> >(tail -2 >&2) \
             | tee -a BENCH_BNB_TPU_KSWEEP_R5.tmp
     done
     [ "$(wc -l < BENCH_BNB_TPU_KSWEEP_R5.tmp)" -ge 4 ] \
@@ -163,12 +169,14 @@ fi
 
 if [ ! -s BENCH_TPU_PIPELINE.json ]; then
     echo "== pipeline (both folds; faster one reported) =="
-    python bench.py 2> >(tail -8 >&2) | tee BENCH_TPU_PIPELINE.json
+    TSP_BENCH_HISTORY=off python bench.py 2> >(tail -8 >&2) | tee BENCH_TPU_PIPELINE.json
+    [ -s BENCH_TPU_PIPELINE.json ] && python tools/bench_check.py \
+        append BENCH_TPU_PIPELINE.json --mode pipeline --backend tpu || true
 fi
 
 if [ ! -s BENCH_BNB_TPU.json ]; then
     echo "== B&B eil51 (north-star metric) =="
-    TSP_BENCH=bnb python bench.py 2> >(tail -3 >&2) | tee BENCH_BNB_TPU.json
+    TSP_BENCH=bnb TSP_BENCH_HISTORY=off python bench.py 2> >(tail -3 >&2) | tee BENCH_BNB_TPU.json
 fi
 
 if [ "$(wc -l < BENCH_BNB_TPU_KSWEEP.jsonl 2>/dev/null || echo 0)" -lt 2 ]; then
@@ -177,7 +185,7 @@ if [ "$(wc -l < BENCH_BNB_TPU_KSWEEP.jsonl 2>/dev/null || echo 0)" -lt 2 ]; then
     echo "== B&B eil51 k-sweep (batch-width tuning evidence) =="
     : > BENCH_BNB_TPU_KSWEEP.tmp
     for K in 256 4096; do
-        TSP_BENCH=bnb TSP_BENCH_K=$K python bench.py 2> >(tail -2 >&2) \
+        TSP_BENCH=bnb TSP_BENCH_K=$K TSP_BENCH_HISTORY=off python bench.py 2> >(tail -2 >&2) \
             | tee -a BENCH_BNB_TPU_KSWEEP.tmp
     done
     [ "$(wc -l < BENCH_BNB_TPU_KSWEEP.tmp)" -ge 2 ] \
@@ -186,14 +194,14 @@ fi
 
 if [ ! -s BENCH_TPU_POLISH.json ]; then
     echo "== pipeline polish fold (measured-length quality headline) =="
-    TSP_BENCH_FOLD=tree_xy_polish python bench.py \
+    TSP_BENCH_FOLD=tree_xy_polish TSP_BENCH_HISTORY=off python bench.py \
         2> >(tail -3 >&2) | tee BENCH_TPU_POLISH.json
     [ -s BENCH_TPU_POLISH.json ] || rm -f BENCH_TPU_POLISH.json
 fi
 
 if [ ! -s BENCH_BNB_TPU_BORUVKA.json ]; then
     echo "== B&B eil51, Boruvka MST kernel (log-depth bound vs Prim) =="
-    TSP_BENCH=bnb TSP_BENCH_MST_KERNEL=boruvka python bench.py \
+    TSP_BENCH=bnb TSP_BENCH_MST_KERNEL=boruvka TSP_BENCH_HISTORY=off python bench.py \
         2> >(tail -3 >&2) | tee BENCH_BNB_TPU_BORUVKA.json
     [ -s BENCH_BNB_TPU_BORUVKA.json ] || rm -f BENCH_BNB_TPU_BORUVKA.json
 fi
@@ -239,6 +247,8 @@ if [ ! -s BENCH_COMPILE_CACHE_TPU.json ]; then
     # parent spawns fresh child processes per measurement; each child
     # claims the chip in turn (same discipline as the chunked driver).
     TSP_BENCH=compile TSP_BENCH_COMPILE_OUT=BENCH_COMPILE_CACHE_TPU.json \
-        python bench.py 2> >(tail -3 >&2) | tail -1
+        TSP_BENCH_HISTORY=off python bench.py 2> >(tail -3 >&2) | tail -1
     [ -s BENCH_COMPILE_CACHE_TPU.json ] || rm -f BENCH_COMPILE_CACHE_TPU.json
+    [ -s BENCH_COMPILE_CACHE_TPU.json ] && python tools/bench_check.py \
+        append BENCH_COMPILE_CACHE_TPU.json --mode compile --backend tpu || true
 fi

@@ -1,7 +1,7 @@
 """CLI: ``python -m tsp_mpi_reduction_tpu.analysis [paths...]``.
 
 Runs BOTH analysis passes over the same surface against one shared
-baseline: graftlint (per-node AST rules R1-R8) and graftflow (the
+baseline: graftlint (per-node AST rules R1-R8 + R13) and graftflow (the
 interprocedural dataflow rules R9-R12). Exit status 0 when the tree is
 clean modulo the checked-in baseline, 1 when new violations or dead
 baseline entries exist, 2 on usage errors. Runs stdlib-only (no JAX
@@ -92,7 +92,7 @@ def main(argv=None) -> int:
 
     ap = argparse.ArgumentParser(
         prog="graftlint",
-        description="JAX-hazard lint: graftlint (R1-R8) + graftflow (R9-R12)",
+        description="JAX-hazard lint: graftlint (R1-R8, R13) + graftflow (R9-R12)",
     )
     ap.add_argument(
         "paths",

@@ -86,6 +86,7 @@ RULES = {
     "R6": "non-atomic write of a durable artifact",
     "R7": "jit frontier entry without buffer donation",
     "R8": "metric/trace recording inside jit-traced code",
+    "R13": "unbounded metric-label cardinality at a registry call site",
 }
 
 #: functions whose WHOLE body R1 treats as a hot loop: the reservoir
@@ -173,6 +174,18 @@ _OBS_RECORDER_VERBS = frozenset(
 )
 #: bare-name recorder calls (``from obs.tracing import span``)
 _OBS_BARE_CALLS = frozenset({"span", "add_event", "emit_span"})
+#: registry receivers R13 governs — label kwargs at these call sites must
+#: have BOUNDED value sets; the registry keeps one series per distinct
+#: label tuple forever, so an f-string / loop-variable / per-request
+#: label is an unbounded-memory + scrape-size leak
+_R13_REGISTRY_ROOTS = frozenset({"REGISTRY", "_REGISTRY"})
+#: recording verbs whose keyword args are label values
+_R13_RECORD_VERBS = frozenset({"inc", "set_gauge", "observe"})
+#: kwargs of those verbs that are NOT labels
+_R13_NON_LABEL_KWARGS = frozenset({"value"})
+#: names that hold a per-request payload (a label drawn from one has
+#: request-cardinality by construction)
+_R13_REQUEST_NAMES = frozenset({"request", "req"})
 #: higher-order tracers (R8): a function passed here by name is traced
 #: exactly like a jit body
 _TRACED_HOF_NAMES = frozenset(
@@ -420,6 +433,7 @@ class _FileLinter(ast.NodeVisitor):
         self.pulled_names: Set[str] = set()  # assigned from host pulls
         self.tainted: Set[str] = set()  # assigned raw from jitted callees
         self.buffer_names: Set[str] = set()  # assigned from io.BytesIO etc.
+        self.loop_targets: Set[str] = set()  # names bound by enclosing fors (R13)
         #: does the current scope os.replace-publish (the atomic pattern)?
         self.atomic_scope = self._scope_is_atomic(tree)
 
@@ -464,6 +478,7 @@ class _FileLinter(ast.NodeVisitor):
             self.buffer_names,
             self.atomic_scope,
             self.jit_scope,
+            self.loop_targets,
         )
         self.scope.append(node.name)
         self.def_lines.append(node.lineno)
@@ -481,6 +496,7 @@ class _FileLinter(ast.NodeVisitor):
         self.pulled_names = set()
         self.tainted = set()
         self.buffer_names = set()
+        self.loop_targets = set()
         self.atomic_scope = self._scope_is_atomic(node)
         self._check_r5(node)
         self._check_r7_def(node)
@@ -498,6 +514,7 @@ class _FileLinter(ast.NodeVisitor):
             self.buffer_names,
             self.atomic_scope,
             self.jit_scope,
+            self.loop_targets,
         ) = saved
 
     # -- loops -------------------------------------------------------------
@@ -506,7 +523,11 @@ class _FileLinter(ast.NodeVisitor):
         self._check_r4(node)
         self.loop_depth += 1
         self.for_depth += 1
+        # R13: names this loop binds are loop-variable labels in its body
+        saved_targets = set(self.loop_targets)
+        self.loop_targets.update(self._target_names([node.target]))
         self.generic_visit(node)
+        self.loop_targets = saved_targets
         self.for_depth -= 1
         self.loop_depth -= 1
 
@@ -607,6 +628,7 @@ class _FileLinter(ast.NodeVisitor):
     def visit_Call(self, node: ast.Call) -> None:
         name = _dotted(node.func)
         self._check_r8(node, name)
+        self._check_r13(node, name)
         in_hot = self.loop_depth > 0 or self.hot
         if in_hot and name in _HOST_PULL_CALLS and node.args:
             if self._is_device_expr(node.args[0]):
@@ -672,6 +694,78 @@ class _FileLinter(ast.NodeVisitor):
                 "a recompile/callback; move the recording to the host "
                 "loop around the dispatch",
             )
+
+    # -- R13: unbounded metric-label cardinality -----------------------------
+
+    @staticmethod
+    def _unwrap_str_call(node: ast.AST) -> ast.AST:
+        """``str(x)`` around a label value changes nothing about its
+        cardinality — look through one conversion layer."""
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "str"
+            and node.args
+        ):
+            return node.args[0]
+        return node
+
+    def _r13_label_hazard(self, value: ast.AST) -> Optional[str]:
+        """Why this label value has unbounded cardinality, or None."""
+        value = self._unwrap_str_call(value)
+        if isinstance(value, ast.JoinedStr):
+            return "an f-string label mints a new series per formatted value"
+        if isinstance(value, ast.Name) and value.id in self.loop_targets:
+            return (
+                f"loop variable {value.id!r} as a label mints one series "
+                "per iteration"
+            )
+        root = _root_name(value)
+        if root in _R13_REQUEST_NAMES and isinstance(
+            value, (ast.Subscript, ast.Attribute)
+        ):
+            return (
+                "a per-request field as a label mints one series per "
+                "distinct request"
+            )
+        if (
+            isinstance(value, ast.Call)
+            and isinstance(value.func, ast.Attribute)
+            and value.func.attr == "get"
+            and _root_name(value.func.value) in _R13_REQUEST_NAMES
+        ):
+            return (
+                "a per-request field as a label mints one series per "
+                "distinct request"
+            )
+        return None
+
+    def _check_r13(self, node: ast.Call, name: Optional[str]) -> None:
+        """The registry keeps one series per distinct label tuple FOREVER
+        (that is what makes delta/scrape semantics work), so a label
+        value drawn from an unbounded set — an f-string, a loop
+        variable, a per-request field — is a memory leak and a scrape
+        explosion. Bounded label sets (tier names, entry names, seam
+        names, literal strings, module constants) are the contract."""
+        if "R13" not in self.rules or name is None or "." not in name:
+            return
+        root, _, _rest = name.partition(".")
+        verb = name.rsplit(".", 1)[-1]
+        if root not in _R13_REGISTRY_ROOTS or verb not in _R13_RECORD_VERBS:
+            return
+        for kw in node.keywords:
+            if kw.arg is None or kw.arg in _R13_NON_LABEL_KWARGS:
+                continue
+            why = self._r13_label_hazard(kw.value)
+            if why:
+                self._emit(
+                    node,
+                    "R13",
+                    f"label {kw.arg!r} at {name}() has unbounded "
+                    f"cardinality: {why} — label with a value from a "
+                    "fixed set (tier/entry/seam name) and put the "
+                    "variable part in the metric VALUE or a span attr",
+                )
 
     # -- R6: non-atomic write of a durable artifact --------------------------
 

@@ -49,6 +49,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..analysis import contracts as _contracts
+from ..obs import anomaly as _obs_anomaly
 from ..obs import metrics as _obs_metrics
 from ..obs import timeseries as _obs_series
 from ..obs import tracing as _obs_tracing
@@ -392,6 +393,11 @@ class BnBResult:
     #: certified-floor trajectory), flushed into the driver JSON; None
     #: under ``TSP_OBS=off``
     series: Optional[dict] = None
+    #: stall-sentinel verdicts (obs.anomaly.StallSentinel: nodes/sec
+    #: collapse, certified-LB stagnation — each also fired as a health
+    #: event + registry counter at detection time); None under
+    #: ``TSP_OBS=off``
+    anomalies: Optional[dict] = None
 
 
 def nearest_neighbor_tour(d: np.ndarray, start: int = 0) -> np.ndarray:
@@ -426,6 +432,27 @@ def _close_from_zero(open_tour: np.ndarray) -> np.ndarray:
     return np.concatenate([open0, open0[:1]]).astype(np.int32)
 
 
+_VPOLISH = None
+
+
+def _vpolish():
+    """Process-global jitted batch polish, built once. The distance matrix
+    is an ARGUMENT, not a closure capture: the old per-call
+    ``jax.jit(jax.vmap(lambda t: polish(t, d32)[0]))`` baked d32 into the
+    jaxpr as a constant, so every strong_incumbent call was a fresh cache
+    entry — ~100 ms of retrace + MLIR lowering per solve at n=12 (the
+    exact R11 recompile hazard graftflow flags), dominating warm-solve
+    wall. jit now caches on (batch, n, device) like every other entry."""
+    global _VPOLISH
+    if _VPOLISH is None:
+        from ..ops.local_search import polish
+
+        _VPOLISH = jax.jit(
+            jax.vmap(lambda t, dd: polish(t, dd)[0], in_axes=(0, None))
+        )
+    return _VPOLISH
+
+
 def strong_incumbent(
     d: np.ndarray,
     starts: int = 8,
@@ -451,8 +478,6 @@ def strong_incumbent(
     re-measured on host in float64, so the incumbent fed to the pruner is
     a true tour cost regardless of the f32 polish.
     """
-    from ..ops.local_search import polish
-
     n = d.shape[0]
     if perturbations is None:
         perturbations = 30 if n >= 30 else 0
@@ -467,7 +492,7 @@ def strong_incumbent(
         return jnp.asarray(arr)
 
     d32 = put(d, np.float32)
-    vpolish = jax.jit(jax.vmap(lambda t: polish(t, d32)[0]))
+    vpolish = lambda tours: _vpolish()(tours, d32)  # noqa: E731
 
     ss = sorted(set(np.linspace(0, n - 1, min(starts, n)).astype(int).tolist()))
     opens = np.stack([nearest_neighbor_tour(d64, s)[:-1] for s in ss])
@@ -2239,6 +2264,17 @@ def solve(
         # spill byte columns count packed rows — record the divisor
         sampler.row_bytes = int(fr.nodes.shape[-1]) * 4
         sampler.frontier_layout = FRONTIER_LAYOUT_VERSION
+    # stall sentinel rides the same per-dispatch feed (ISSUE 9): nodes/s
+    # collapse + certified-LB stagnation fire health events mid-solve.
+    # Attached to the sampler so the hot loop makes ONE telemetry call
+    # per dispatch (sample() forwards), not two keyword calls — measured
+    # difference on the TSP_BENCH=obs <= 2% budget.
+    sentinel = _obs_anomaly.StallSentinel.maybe()
+    if sampler is not None:
+        sampler.sentinel = sentinel
+    # certified floor fed to telemetry/checkpoints: loop-invariant (both
+    # terms are fixed before the loop), so hoist the max() out of it
+    lbf = float(max(lb_floor, root_lb))
     # profiler step annotation, resolved ONCE (shared nullcontext unless
     # a device_trace capture is live around this solve)
     step_ann = _obs_tracing.step_annotation_factory()
@@ -2356,20 +2392,23 @@ def solve(
             and it - last_ckpt >= checkpoint_every
         ):
             save(checkpoint_path, fr, inc_cost, inc_tour, d=d, bound=bound,
-                 reservoir=reservoir, lb_floor=max(lb_floor, root_lb))
+                 reservoir=reservoir, lb_floor=lbf)
             last_ckpt = it
         if sampler is not None:
+            # positional on purpose: the kwarg spelling costs ~1 us more
+            # per dispatch in situ (column order = timeseries.COLUMNS)
             now = time.perf_counter()
             sampler.sample(
-                step=it,
-                wall_s=now - t0,
-                nodes=iter_nodes,
-                nodes_per_s=iter_nodes / max(now - t_iter, 1e-9),
-                frontier=cnt,
-                spill_to_host=spill_stats.bytes_to_host - sp_h0,
-                spill_to_device=spill_stats.bytes_to_device - sp_d0,
-                incumbent=ic,
-                lb_floor=max(lb_floor, root_lb),
+                it,
+                now - t0,
+                iter_nodes,
+                iter_nodes / max(now - t_iter, 1e-9),
+                cnt,
+                spill_stats.bytes_to_host - sp_h0,
+                spill_stats.bytes_to_device - sp_d0,
+                ic,
+                lbf,
+                len(reservoir),
             )
         if cnt == 0:
             break
@@ -2385,7 +2424,7 @@ def solve(
         # always leave a resumable snapshot when stopping early (time limit,
         # iteration cap, target reached)
         save(checkpoint_path, fr, inc_cost, inc_tour, d=d, bound=bound,
-             reservoir=reservoir, lb_floor=max(lb_floor, root_lb))
+             reservoir=reservoir, lb_floor=lbf)
     lb_raw = _final_lower_bound(
         proven, float(inc_cost), root_lb,
         [np.asarray(fr.bound[: int(fr.count)])], reservoir,
@@ -2415,6 +2454,7 @@ def solve(
         spill_bytes_to_host=spill_stats.bytes_to_host,
         spill_bytes_to_device=spill_stats.bytes_to_device,
         series=sampler.series() if sampler is not None else None,
+        anomalies=sentinel.summary() if sentinel is not None else None,
     )
 
 
@@ -2998,6 +3038,13 @@ def solve_sharded(
     if sampler is not None:
         sampler.row_bytes = int(fr.nodes.shape[-1]) * 4
         sampler.frontier_layout = FRONTIER_LAYOUT_VERSION
+    # stall sentinel (ISSUE 9): same per-dispatch feed as the sampler —
+    # attached so the loop makes one telemetry call per dispatch
+    sentinel = _obs_anomaly.StallSentinel.maybe()
+    if sampler is not None:
+        sampler.sentinel = sentinel
+    # loop-invariant certified floor for telemetry/checkpoints
+    lbf = float(max(lb_floor, root_lb))
     step_ann = _obs_tracing.step_annotation_factory()
     while it < max_iters:
         t_iter = time.perf_counter()
@@ -3072,21 +3119,23 @@ def solve_sharded(
         ):
             save(checkpoint_path, fr, ic, itour, d=d, bound=bound,
                  num_ranks=num_ranks, reservoir=_merge_reservoirs(reservoirs),
-                 lb_floor=max(lb_floor, root_lb))
+                 lb_floor=lbf)
             last_ckpt = it
         if sampler is not None:
+            # positional on purpose (column order = timeseries.COLUMNS)
             now = time.perf_counter()
             step_n = int(step_nodes[0])
             sampler.sample(
-                step=it,
-                wall_s=now - t0,
-                nodes=step_n,
-                nodes_per_s=step_n / max(now - t_iter, 1e-9),
-                frontier=int(total0),
-                spill_to_host=spill_stats.bytes_to_host - sp_h0,
-                spill_to_device=spill_stats.bytes_to_device - sp_d0,
-                incumbent=best,
-                lb_floor=max(lb_floor, root_lb),
+                it,
+                now - t0,
+                step_n,
+                step_n / max(now - t_iter, 1e-9),
+                int(total0),
+                spill_stats.bytes_to_host - sp_h0,
+                spill_stats.bytes_to_device - sp_d0,
+                best,
+                lbf,
+                sum(len(rv) for rv in reservoirs),
             )
         if int(total0) == 0:
             break
@@ -3102,7 +3151,7 @@ def solve_sharded(
     if checkpoint_path and not proven:
         save(checkpoint_path, fr, ic, itour, d=d, bound=bound,
              num_ranks=num_ranks, reservoir=_merge_reservoirs(reservoirs),
-             lb_floor=max(lb_floor, root_lb))
+             lb_floor=lbf)
     counts = np.asarray(fr.count)
     bounds_h = np.asarray(fr.bound)
     merged_res = _merge_reservoirs(reservoirs) or _Reservoir()
@@ -3136,6 +3185,7 @@ def solve_sharded(
         spill_bytes_to_host=spill_stats.bytes_to_host,
         spill_bytes_to_device=spill_stats.bytes_to_device,
         series=sampler.series() if sampler is not None else None,
+        anomalies=sentinel.summary() if sentinel is not None else None,
     )
 
 

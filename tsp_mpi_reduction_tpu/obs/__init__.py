@@ -23,6 +23,24 @@ Three pillars, one package, stdlib-only on the hot paths:
   incumbent/certified-floor trajectory) flushed into the solver result
   and driver JSON; ``tools/obs_report.py`` renders both artifacts.
 
+The performance-accounting layer (ISSUE 9) consumes those pillars:
+
+- :mod:`.costs` — XLA cost attribution per compiled hot entry
+  (``Compiled.cost_analysis``/``memory_analysis`` at compile/AOT-load
+  time, roofline utilization estimate vs a per-backend peak table) →
+  the ``obs.device_costs`` stats block + ``xla_entry_*`` gauges.
+- :mod:`.bench_history` — fingerprinted ``bench_history.jsonl`` records
+  appended by every ``TSP_BENCH`` run + the median/MAD regression
+  detector behind ``make bench-check``.
+- :mod:`.slo` — per-tier serve latency objectives: session-window
+  attainment + error-budget burn rate from the tier-labeled latency
+  histograms (the stats ``slo`` block).
+- :mod:`.anomaly` — the ``StepSampler``-fed stall sentinel (nodes/sec
+  collapse, certified-LB stagnation) firing health events mid-solve.
+- :mod:`.tracing` additionally propagates across PROCESSES via the
+  ``TSP_TRACE_PARENT=<trace_id>:<span_id>`` env contract, so a chunked
+  campaign reconstructs as one span tree.
+
 Gating: ``TSP_OBS=off`` disables the *optional-overhead* telemetry —
 span tracing, the per-step sampler, profiler step annotations, phase
 mirroring. Plain registry counters stay on regardless: they replace the

@@ -1,0 +1,260 @@
+"""StepSampler-fed solver anomaly sentinel (ISSUE 9 tentpole).
+
+The B&B host loops already sample one telemetry row per dispatch
+(``obs.timeseries.StepSampler``); this module watches that stream *live*
+and fires health events when the search goes pathological:
+
+``nodes_rate_collapse``
+    the median nodes/sec of the newest ``window`` dispatches fell below
+    ``collapse_ratio`` x the median of the preceding windows — the shape
+    of a degraded relay (the ~65 ms/iteration post-readback mode), a
+    wedged backend, or a frontier thrashing against its spill headroom.
+
+``lb_stagnation``
+    over the last ``lb_window`` dispatches the certified lower-bound
+    floor gained less than ``lb_min_gain`` AND the incumbent did not
+    improve AND the total open work (frontier + host reservoir) did not
+    shrink — zero progress on both ends of the gap while the search is
+    NOT draining toward a proof. The drain condition is load-bearing:
+    within one solve the certified floor is clamped once at setup and
+    cannot move, and the incumbent is legitimately flat for the entire
+    prove-the-incumbent endgame — without it the detector fired on
+    every healthy proof run longer than ``lb_window`` dispatches
+    (reproduced on the TSP_BENCH=obs config). A draining frontier IS
+    gap progress; only a search holding/growing its open set while both
+    bounds sit still is stalled. This is the run-to-exhaustion signal
+    the chunked driver's stall rule sees only at chunk granularity;
+    here it fires mid-chunk, per dispatch.
+
+Events go three places, all pre-existing consumer surfaces: the health
+counter block (``resilience.health`` → ``health_events_total{event=…}`` —
+the serve watchdog and the chunked driver already read health blocks),
+the metrics registry (``bnb_anomalies_total{kind=…}``), and the active
+span as a trace event (a campaign trace shows WHERE the collapse
+happened). Each detector fires once per episode (re-arming only after
+the signal recovers), so a long degraded stretch is one event, not one
+per dispatch.
+
+Overhead discipline: the sentinel exists only when obs is enabled
+(``maybe()``, mirroring ``StepSampler.maybe``), and the per-dispatch
+path is two list appends and a counter compare — ALL analysis (medians,
+window anchors, stagnation spans) runs once per ``window`` samples. The
+TSP_BENCH=obs <= 2% budget prices this in; the sentinel was rewritten
+to this amortized shape after the first wiring measurably pushed the
+bench over it.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from statistics import median as _median
+from typing import Any, Dict, List, Optional
+
+from . import enabled as _obs_enabled
+from .metrics import REGISTRY
+
+#: window medians kept as the collapse baseline (current window judged
+#: against the median of the previous up-to-this-many window medians)
+_BASELINE_WINDOWS = 4
+
+
+class StallSentinel:
+    """Streaming detector over (nodes/sec, certified-LB-floor, incumbent)
+    samples. Hot path: buffer the sample; every ``window`` samples, run
+    both checks on the buffered window."""
+
+    __slots__ = (
+        "window", "collapse_ratio", "lb_window", "lb_min_gain", "min_rate",
+        "_buf", "_count", "_last", "_medians", "_anchors",
+        "_rate_alarmed", "_lb_alarmed", "events", "consumed",
+    )
+
+    def __init__(
+        self,
+        *,
+        window: int = 16,
+        collapse_ratio: float = 0.25,
+        lb_window: int = 256,
+        lb_min_gain: float = 1e-9,
+        min_rate: float = 0.0,
+    ) -> None:
+        if window < 2:
+            raise ValueError(f"window must be >= 2, got {window}")
+        self.window = window
+        self.collapse_ratio = collapse_ratio
+        self.lb_window = lb_window
+        self.lb_min_gain = lb_min_gain
+        #: rates at/below this never enter a window median (a dispatch
+        #: that popped nothing is a refill boundary, not a healthy rate)
+        self.min_rate = min_rate
+        self._buf: List[float] = []  # current window's rates (hot append)
+        self._count = 0  # samples in the current window (hot compare)
+        #: newest sample: (step, lb_floor, incumbent, open_nodes)
+        self._last: tuple = (0, float("-inf"), float("inf"), 0)
+        self._medians: deque = deque(maxlen=_BASELINE_WINDOWS)
+        #: one (step, lb_floor, incumbent) anchor per completed window;
+        #: stagnation compares the oldest vs newest anchor, so the span
+        #: covers ~lb_window dispatches at window-granular anchors
+        self._anchors: deque = deque(maxlen=max(2, lb_window // window))
+        self._rate_alarmed = False
+        self._lb_alarmed = False
+        #: fired events, newest-last: [{"kind", "step", ...detail}]
+        self.events: List[Dict[str, Any]] = []
+        #: sampler-ring rows already consumed (see :meth:`consume`)
+        self.consumed = 0
+
+    @classmethod
+    def maybe(cls, **kw) -> Optional["StallSentinel"]:
+        """A sentinel when obs is enabled, else None (one is-None check
+        per dispatch under ``TSP_OBS=off`` — same contract as the
+        sampler it rides next to)."""
+        return cls(**kw) if _obs_enabled() else None
+
+    # -- the per-dispatch feed ----------------------------------------------
+
+    def feed(
+        self,
+        step: int,
+        nodes_per_s: float,
+        lb_floor: float,
+        incumbent: float,
+        open_nodes: int = 0,
+    ) -> Optional[List[Dict[str, Any]]]:
+        """Positional hot-path feed (direct callers / :meth:`observe`):
+        returns None except at a window boundary, where it returns the
+        events fired by the flush. ``open_nodes`` = total open work
+        (frontier + reservoir) — the stagnation check's drain signal.
+        All analysis (medians, anchors, stagnation spans) is amortized
+        into that once-per-``window`` flush."""
+        if nodes_per_s > self.min_rate:
+            self._buf.append(nodes_per_s)
+        self._count += 1
+        if self._count < self.window:
+            return None
+        self._last = (step, lb_floor, incumbent, open_nodes)
+        return self._flush_window()
+
+    def consume(self, sampler) -> Optional[List[Dict[str, Any]]]:
+        """Ring-fed batch path (what ``StepSampler.sample`` uses): pull
+        every row appended to the sampler's ring since the last consume
+        and run the window checks. The sampler calls this only when a
+        full window has accrued, so the PER-DISPATCH sentinel cost is
+        one attribute load + one integer compare — the second Python
+        call per dispatch that :meth:`feed` used to be was about half
+        the telemetry budget on the TSP_BENCH=obs gate. Semantics match
+        feed(): same min-rate filter, same window cadence, and the
+        window's newest row provides the (step, lb_floor, incumbent,
+        open-work) anchor."""
+        total, cap, rows = sampler._total, sampler.capacity, sampler._rows
+        # rows older than the ring still holds cannot be replayed
+        start = max(self.consumed, total - cap)
+        wrapped = len(rows) == cap
+        mr = self.min_rate
+        buf = self._buf
+        r = None
+        for i in range(start, total):
+            r = rows[i % cap] if wrapped else rows[i]
+            rate = r[3]  # timeseries.COLUMNS: nodes_per_s
+            if rate > mr:
+                buf.append(rate)
+        self._count += total - start
+        self.consumed = total
+        if self._count < self.window or r is None:
+            return None
+        # step, lb_floor, incumbent, frontier + reservoir (open work)
+        self._last = (r[0], r[8], r[7], r[4] + r[9])
+        return self._flush_window()
+
+    def observe(
+        self,
+        *,
+        step: int,
+        nodes_per_s: float,
+        lb_floor: float,
+        incumbent: float = float("inf"),
+        open_nodes: int = 0,
+    ) -> List[Dict[str, Any]]:
+        """Keyword wrapper over :meth:`feed` (tests / direct callers);
+        returns the events fired by this sample (usually empty)."""
+        return self.feed(step, nodes_per_s, lb_floor, incumbent, open_nodes) or []
+
+    def _flush_window(self) -> List[Dict[str, Any]]:
+        self._count = 0
+        fired: List[Dict[str, Any]] = []
+        step = self._last[0]
+        if self._buf:
+            cur = _median(self._buf)
+            self._buf = []
+            if len(self._medians) == self._medians.maxlen:
+                fired.extend(self._check_rate(step, cur))
+            self._medians.append(cur)
+        self._anchors.append(self._last)
+        if len(self._anchors) == self._anchors.maxlen:
+            fired.extend(self._check_lb(step))
+        return fired
+
+    def _fire(self, kind: str, step: int, **detail: Any) -> Dict[str, Any]:
+        event = {"kind": kind, "step": int(step), **detail}
+        self.events.append(event)
+        REGISTRY.inc("bnb_anomalies_total", kind=kind)
+        # the health block is the cross-layer consumer surface: the serve
+        # watchdog and the chunked driver already parse it
+        from ..resilience.health import HEALTH
+
+        HEALTH.incr(f"anomaly_{kind}")
+        from . import tracing as _tracing
+
+        _tracing.add_event(f"anomaly_{kind}", **{"step": int(step), **detail})
+        return event
+
+    def _check_rate(self, step: int, cur: float) -> List[Dict[str, Any]]:
+        baseline = _median(self._medians)
+        collapsed = baseline > 0 and cur < self.collapse_ratio * baseline
+        if collapsed and not self._rate_alarmed:
+            self._rate_alarmed = True
+            return [self._fire(
+                "nodes_rate_collapse", step,
+                recent_median=round(cur, 3),
+                baseline_median=round(baseline, 3),
+                ratio=round(cur / baseline, 4),
+            )]
+        if not collapsed:
+            self._rate_alarmed = False  # episode over: re-arm
+        return []
+
+    def _check_lb(self, step: int) -> List[Dict[str, Any]]:
+        step0, lb0, inc0, open0 = self._anchors[0]
+        step1, lb1, inc1, open1 = self._anchors[-1]
+        span_steps = step1 - step0
+        # total stagnation only: a flat certified floor is NORMAL mid-DFS
+        # (within one solve it CANNOT move — it is clamped once at setup),
+        # and a flat incumbent is the entire prove-the-incumbent endgame.
+        # The verdict therefore also needs the open work (frontier +
+        # reservoir) to be holding/growing: a draining open set IS gap
+        # progress, and without this condition the detector fired on
+        # every healthy proof run longer than lb_window dispatches.
+        floor_flat = (
+            lb0 > float("-inf") and (lb1 - lb0) < self.lb_min_gain
+        )
+        inc_flat = not (inc1 < inc0 - self.lb_min_gain)
+        not_draining = open1 >= open0
+        stagnant = span_steps > 0 and floor_flat and inc_flat and not_draining
+        if stagnant and not self._lb_alarmed:
+            self._lb_alarmed = True
+            return [self._fire(
+                "lb_stagnation", step,
+                lb_floor=round(lb1, 6),
+                gain=round(lb1 - lb0, 9),
+                over_steps=int(span_steps),
+                open_nodes=int(open1),
+            )]
+        if not stagnant:
+            self._lb_alarmed = False
+        return []
+
+    def summary(self) -> Dict[str, Any]:
+        """JSON-ready tail for the solver result / driver payload."""
+        return {
+            "events": list(self.events),
+            "fired": len(self.events),
+        }

@@ -1,0 +1,386 @@
+"""Benchmark history + median/MAD regression sentinel (ISSUE 9 tentpole).
+
+Every ``TSP_BENCH=*`` run (and every ``tools/tpu_bench.sh`` leg) appends
+one fingerprinted record to ``bench_history.jsonl``: what was measured
+(``metric``/``value``/``unit`` — the same headline every ``BENCH_*.json``
+artifact carries), and under which conditions (git rev, jax+jaxlib
+version, backend, a hash of the bench's config knobs). The one-shot
+artifacts stay — they are the *latest* full evidence — but the history is
+what gives the repo a perf *trajectory*: ``tools/bench_check.py`` (and
+``make bench-check``, chained into the default ``make``) compares the
+newest sample of every governed metric against the median of its prior
+samples under the same (backend, config) conditions, with a MAD-scaled
+noise floor, and FAILS on a regression instead of letting it age
+invisibly inside a JSON file.
+
+Append discipline: one ``O_APPEND`` write of one complete line under an
+``flock`` — the same crash-safety posture as ``write_json_atomic``
+(``resilience.checkpoint``), adapted to an append-only log: a writer
+killed mid-call leaves either no line or a whole line (the lock orders
+concurrent writers; a torn tail from a hard kill is skipped by the
+reader, exactly like the trace JSONL reader).
+
+Detection model (:func:`check`): per metric, per (backend, config_hash,
+host-fingerprint) group — samples from different hardware classes or
+configs never vote on each other (:func:`host_fingerprint` hashes
+arch + cores + CPU model, NOT the hostname, so ephemeral CI containers
+on one hardware pool still share a history) — the newest value
+regresses when its direction-adjusted deviation
+from the median of the PRIOR samples exceeds
+``max(rel_threshold * |median|, abs_threshold, mad_k * 1.4826 * MAD)``.
+The MAD term is the noise floor: a metric that historically wobbles ±8%
+is not failed for a 5% dip, while a historically flat one is. Below
+``min_samples`` prior samples the verdict is ``insufficient`` — recorded,
+never failing — so a fresh clone's first benches pass while the history
+accretes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import time
+from statistics import median as _median
+from dataclasses import dataclass
+from typing import Any, Dict, Iterable, List, Optional
+
+SCHEMA_VERSION = 1
+
+#: default history file, repo-root-relative; override (or disable with
+#: "off") via this env var — tests point it at a tmp dir, benches in CI
+#: at the checked-in file
+ENV_VAR = "TSP_BENCH_HISTORY"
+_DISABLED = ("off", "0", "none", "disabled")
+DEFAULT_PATH = "bench_history.jsonl"
+
+
+def resolve_history_path(default_dir: Optional[str] = None) -> Optional[str]:
+    """The configured history path, or None when appending is disabled."""
+    val = os.environ.get(ENV_VAR)
+    if val is None:
+        base = default_dir if default_dir is not None else os.getcwd()
+        return os.path.join(base, DEFAULT_PATH)
+    val = val.strip()
+    if not val or val.lower() in _DISABLED:
+        return None
+    return val
+
+
+# -- fingerprinting ------------------------------------------------------------
+
+
+def git_rev(cwd: Optional[str] = None) -> Optional[str]:
+    """Short rev of the working tree the bench ran in (None outside git —
+    the record is still useful, just unpinned)."""
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10.0,
+            cwd=cwd or os.path.dirname(os.path.abspath(__file__)),
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    rev = out.stdout.strip()
+    return rev if out.returncode == 0 and rev else None
+
+
+def config_hash(config: Dict[str, Any]) -> str:
+    """Stable digest of the bench's config knobs: two records compare only
+    when they measured the same thing the same way."""
+    payload = json.dumps(config, sort_keys=True, default=str)
+    return hashlib.blake2b(payload.encode(), digest_size=8).hexdigest()
+
+
+def host_fingerprint() -> str:
+    """Digest of the measuring HARDWARE class: arch + logical cores + CPU
+    model string. Grouping on this (not the hostname) keeps the promise
+    that samples from different machines never vote on each other, while
+    still letting ephemeral CI containers on the same hardware pool
+    accrete one shared history — a container hostname is random per run
+    and would pin every group at min_samples forever."""
+    model = ""
+    try:
+        with open("/proc/cpuinfo", encoding="utf-8") as fh:
+            for line in fh:
+                if line.lower().startswith(("model name", "hardware", "cpu model")):
+                    model = line.partition(":")[2].strip()
+                    break
+    except OSError:
+        pass
+    import platform
+
+    payload = f"{platform.machine()}|{os.cpu_count()}|{model}"
+    return hashlib.blake2b(payload.encode(), digest_size=6).hexdigest()
+
+
+def _jax_versions() -> Dict[str, Optional[str]]:
+    """jax/jaxlib versions WITHOUT importing jax (a parent bench spawner
+    must never initialize a backend): read them only if already loaded."""
+    jax = sys.modules.get("jax")
+    jaxlib = sys.modules.get("jaxlib")
+    return {
+        "jax": getattr(jax, "__version__", None),
+        "jaxlib": getattr(jaxlib, "__version__", None),
+    }
+
+
+def make_record(
+    mode: str,
+    artifact: Dict[str, Any],
+    *,
+    config: Optional[Dict[str, Any]] = None,
+    backend: Optional[str] = None,
+    ts: Optional[float] = None,
+) -> Dict[str, Any]:
+    """One history line from a bench artifact: the headline triple plus
+    the fingerprint. ``config`` is whatever knob dict the bench considers
+    identity-defining (instance, k, reps, ...); ``backend`` defaults to
+    the live jax backend when jax is already imported, else "unknown"."""
+    if backend is None:
+        jax = sys.modules.get("jax")
+        try:
+            backend = jax.default_backend() if jax is not None else "unknown"
+        except Exception:  # noqa: BLE001 — a dead backend is not a reason to drop history
+            backend = "unknown"
+    cfg = dict(config or {})
+    rec: Dict[str, Any] = {
+        "schema": SCHEMA_VERSION,
+        "ts": round(time.time(), 3) if ts is None else ts,
+        "mode": mode,
+        "metric": artifact.get("metric"),
+        "value": artifact.get("value"),
+        "unit": artifact.get("unit"),
+        "ok": artifact.get("ok"),
+        "vs_baseline": artifact.get("vs_baseline"),
+        "git_rev": git_rev(),
+        "backend": backend,
+        "host": host_fingerprint(),
+        "config": cfg,
+        "config_hash": config_hash(cfg),
+    }
+    rec.update(_jax_versions())
+    return rec
+
+
+# -- the locked append ---------------------------------------------------------
+
+
+def append(path: str, record: Dict[str, Any]) -> None:
+    """Append one record as one line: ``O_APPEND`` + ``flock`` so
+    concurrent benches (tpu_bench.sh legs, parallel CI shards on a shared
+    checkout) interleave whole lines, never bytes. Raises only for a
+    non-dict record; IO errors are swallowed — history is an observer."""
+    if not isinstance(record, dict):
+        raise TypeError(f"history record must be a dict, got {type(record).__name__}")
+    line = json.dumps(record) + "\n"
+    try:
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        try:
+            try:
+                import fcntl
+
+                fcntl.flock(fd, fcntl.LOCK_EX)
+            except (ImportError, OSError):
+                pass  # no flock (non-POSIX): O_APPEND alone is still line-atomic for short lines
+            os.write(fd, line.encode())
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    except OSError:
+        pass
+
+
+def read(path: str) -> List[Dict[str, Any]]:
+    """All parseable records, file order (== append order). Malformed
+    lines (a torn tail from a hard kill) are skipped, like read_trace."""
+    records: List[Dict[str, Any]] = []
+    try:
+        with open(path, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(rec, dict) and rec.get("metric") is not None:
+                    records.append(rec)
+    except OSError:
+        return []
+    return records
+
+
+# -- regression detection ------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class MetricRule:
+    """Per-metric regression policy.
+
+    ``direction``: "lower" = smaller is better (wall ms), "higher" =
+    bigger is better (nodes/sec, speedup ratios). ``rel_threshold`` /
+    ``abs_threshold``: the explicit tolerance band; ``mad_k`` scales the
+    history's own MAD (x1.4826 = sigma-consistent) into a noise floor so
+    a naturally jittery metric does not cry wolf. ``min_samples``: prior
+    samples required before the rule can FAIL anything."""
+
+    direction: str = "lower"
+    rel_threshold: float = 0.20
+    abs_threshold: float = 0.0
+    mad_k: float = 3.0
+    min_samples: int = 4
+
+    def __post_init__(self):
+        if self.direction not in ("lower", "higher"):
+            raise ValueError(f"direction must be lower|higher, got {self.direction!r}")
+
+
+#: the governed metrics: every bench mode's headline. Throughput/wall
+#: thresholds sit at 15% — tight enough that a 20% slowdown FAILS (the
+#: acceptance bar), loose enough that the documented ±8% host drift
+#: passes; the MAD floor widens the band further on metrics whose own
+#: history proves noisier than that. Subprocess-startup ratios get 30%
+#: (cold-start wall is the jitteriest thing this repo measures).
+DEFAULT_RULES: Dict[str, MetricRule] = {
+    "pipeline_16x100_wall_ms": MetricRule(direction="lower", rel_threshold=0.15),
+    "bnb_eil51_nodes_per_sec": MetricRule(direction="higher", rel_threshold=0.15),
+    "bnb_burma14_nodes_per_sec": MetricRule(direction="higher", rel_threshold=0.15),
+    "sharded_spill_transfer_bytes_per_round": MetricRule(
+        direction="lower", rel_threshold=0.15
+    ),
+    "serve_microbatch_vs_sequential_throughput": MetricRule(
+        direction="higher", rel_threshold=0.15
+    ),
+    "compile_once_warm_start": MetricRule(direction="higher", rel_threshold=0.30),
+    "fused_vs_reference_expansion_step": MetricRule(
+        direction="higher", rel_threshold=0.15
+    ),
+    # percentage near zero: relative bands are meaningless, use absolute
+    # (obs overhead may drift 0.5% -> 1.4% without failing; 0.5% -> 4%
+    # fails — the <=2% acceptance is the bench's own gate, this one
+    # catches creep across commits)
+    "obs_overhead": MetricRule(
+        direction="lower", rel_threshold=0.0, abs_threshold=2.5, min_samples=4
+    ),
+    # marginal per-dispatch hook cost in us: a per-pair wall-diff
+    # estimate, so the band is absolute (position/cache noise is ~±2 us
+    # at the bench's dispatch sizes) — catches a hook regression (an
+    # added registry call or host sync per dispatch is +1-10 us) that
+    # a wall ratio dilutes away at coarse dispatch granularity
+    "obs_us_per_dispatch": MetricRule(
+        direction="lower", rel_threshold=0.0, abs_threshold=4.0, min_samples=4
+    ),
+    "atomic_checkpoint_overhead": MetricRule(
+        direction="lower", rel_threshold=0.0, abs_threshold=5.0, min_samples=4
+    ),
+}
+
+
+@dataclass
+class Verdict:
+    metric: str
+    group: str  # "backend/config_hash"
+    status: str  # "ok" | "regression" | "insufficient" | "no_value"
+    latest: Optional[float] = None
+    median: Optional[float] = None
+    mad: Optional[float] = None
+    allowed: Optional[float] = None
+    deviation: Optional[float] = None
+    samples: int = 0
+    detail: str = ""
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {k: v for k, v in self.__dict__.items()}
+
+
+def check(
+    records: Iterable[Dict[str, Any]],
+    rules: Optional[Dict[str, MetricRule]] = None,
+) -> List[Verdict]:
+    """Evaluate the NEWEST sample of every governed metric against its
+    prior samples, per (backend, config_hash) group. Returns one Verdict
+    per (metric, group) that has at least one sample; callers fail on any
+    ``status == "regression"``."""
+    rules = DEFAULT_RULES if rules is None else rules
+    groups: Dict[tuple, List[Dict[str, Any]]] = {}
+    for rec in records:
+        metric = rec.get("metric")
+        if metric not in rules:
+            continue
+        # host rides the group key: per-dispatch hook costs and wall
+        # metrics vary severalfold across hardware classes — a fresh
+        # clone on a slower machine must start its own history, not be
+        # failed against the author's laptop (records from pre-host
+        # schema versions group under "?" and age out naturally)
+        key = (
+            metric,
+            rec.get("backend", "unknown"),
+            rec.get("config_hash", ""),
+            rec.get("host", "?"),
+        )
+        groups.setdefault(key, []).append(rec)
+
+    verdicts: List[Verdict] = []
+    for (metric, backend, cfg, host), recs in sorted(groups.items()):
+        rule = rules[metric]
+        group = f"{backend}/{cfg or '-'}/{host}"
+        vals = [
+            float(r["value"])
+            for r in recs
+            if isinstance(r.get("value"), (int, float))
+        ]
+        if not vals:
+            verdicts.append(Verdict(metric, group, "no_value",
+                                    samples=len(recs),
+                                    detail="no numeric samples"))
+            continue
+        latest, prior = vals[-1], vals[:-1]
+        if len(prior) < rule.min_samples:
+            verdicts.append(Verdict(
+                metric, group, "insufficient", latest=latest,
+                samples=len(vals),
+                detail=f"{len(prior)} prior < min_samples={rule.min_samples}",
+            ))
+            continue
+        med = _median(prior)
+        mad = _median([abs(v - med) for v in prior])
+        allowed = max(
+            rule.rel_threshold * abs(med),
+            rule.abs_threshold,
+            rule.mad_k * 1.4826 * mad,
+        )
+        # positive deviation == worse, whatever the metric's direction
+        deviation = (latest - med) if rule.direction == "lower" else (med - latest)
+        status = "regression" if deviation > allowed else "ok"
+        verdicts.append(Verdict(
+            metric, group, status, latest=latest, median=round(med, 6),
+            mad=round(mad, 6), allowed=round(allowed, 6),
+            deviation=round(deviation, 6), samples=len(vals),
+            detail=(
+                f"latest {latest:g} vs median {med:g} "
+                f"({'+' if deviation >= 0 else ''}{deviation:g} worse, "
+                f"allowed {allowed:g})"
+            ),
+        ))
+    return verdicts
+
+
+def load_rules(path: str) -> Dict[str, MetricRule]:
+    """Rules from a JSON file ``{metric: {direction, rel_threshold, ...}}``
+    MERGED over the defaults (a project tunes thresholds without
+    restating the whole table; ``null`` drops a default metric)."""
+    with open(path, encoding="utf-8") as fh:
+        raw = json.load(fh)
+    rules = dict(DEFAULT_RULES)
+    for metric, spec in raw.items():
+        if spec is None:
+            rules.pop(metric, None)
+            continue
+        rules[metric] = MetricRule(**spec)
+    return rules

@@ -1,0 +1,217 @@
+"""XLA cost attribution for the compiled hot entries (ISSUE 9 tentpole).
+
+``perf.compile_cache`` owns every compiled executable in the process —
+the AOT store hands it a ready ``Compiled`` at every miss, hit, and warm
+call. This module turns that custody into *performance accounting*: at
+capture time it reads ``Compiled.cost_analysis()`` (flops, bytes
+accessed, transcendentals) and ``Compiled.memory_analysis()`` (argument /
+output / temp bytes), derives the arithmetic intensity (flops per byte
+moved) and a roofline utilization estimate against a coarse per-backend
+peak table, mirrors everything as ``xla_entry_*{entry=…}`` registry
+gauges, and exposes one JSON-ready ``obs.device_costs`` block that
+``bnb_solve.py`` / the serve stats JSON / the bench artifacts stamp
+(``utils.reporting.obs_block``).
+
+The roofline numbers are *estimates from the model's own cost analysis*,
+not measurements: XLA's flop counts are analytical, the peak table is a
+coarse spec-sheet figure (override per host with ``TSP_PEAK_FLOPS`` /
+``TSP_PEAK_BYTES_PER_S``), and the utilization estimate is the classic
+``min(peak, intensity * bw) / peak`` attainable-fraction — good enough to
+say "this entry is bandwidth-bound at ~0.4 intensity" (the ISSUE 8
+expansion step) and to watch the ratio move across layout changes, not to
+replace a profiler.
+
+Capture never runs on a hot path — only at compile / AOT-load time, a
+once-per-process-per-entry event — and every failure (older jaxlib,
+backend without cost analysis, missing fields) degrades to "no block for
+that entry" plus a counted ``xla_cost_capture_failures_total``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, Optional
+
+from .metrics import REGISTRY
+
+#: coarse per-backend peaks used for the roofline estimate. Values are
+#: deliberately round spec-sheet figures (f32 for TPU — the engine's
+#: screening dtype; one-socket AVX2 ballpark for CPU): the estimate's job
+#: is the *shape* of the roofline (memory- vs compute-bound, order of
+#: magnitude of attainable fraction), not benchmarking the host. Override
+#: with TSP_PEAK_FLOPS / TSP_PEAK_BYTES_PER_S when a real machine's
+#: numbers are known.
+BACKEND_PEAKS: Dict[str, Dict[str, float]] = {
+    # v5e f32 ~ 197 TFLOP/s bf16 -> ~49 TFLOP/s f32-ish; HBM ~ 819 GB/s
+    "tpu": {"flops_per_s": 4.9e13, "bytes_per_s": 8.19e11},
+    # one modern x86 socket, AVX2 f32 ~ 1 TFLOP/s; ~80 GB/s DRAM
+    "cpu": {"flops_per_s": 1.0e12, "bytes_per_s": 8.0e10},
+    # accelerator we have no table row for: order-of-magnitude GPU-ish
+    "default": {"flops_per_s": 1.0e13, "bytes_per_s": 5.0e11},
+}
+
+
+def backend_peaks(backend: str) -> Dict[str, float]:
+    """The peak row for ``backend`` with env overrides applied."""
+    row = dict(BACKEND_PEAKS.get(backend, BACKEND_PEAKS["default"]))
+    for env, key in (
+        ("TSP_PEAK_FLOPS", "flops_per_s"),
+        ("TSP_PEAK_BYTES_PER_S", "bytes_per_s"),
+    ):
+        val = os.environ.get(env, "").strip()
+        if val:
+            try:
+                row[key] = float(val)
+            except ValueError:
+                pass  # a bad override must not take cost capture down
+    return row
+
+
+_lock = threading.Lock()
+#: entry -> captured cost record (JSON-ready); process-global like STATS
+_COSTS: Dict[str, Dict[str, Any]] = {}
+
+#: schema version stamped into every record (and the on-disk memo the
+#: compile cache keeps next to the AOT executables) — bump on any field
+#: change so a stale memo from an older layout is re-captured
+SCHEMA_VERSION = 1
+
+_GAUGES = (
+    ("flops", "xla_entry_flops"),
+    ("bytes_accessed", "xla_entry_bytes_accessed"),
+    ("peak_memory_bytes", "xla_entry_peak_memory_bytes"),
+    ("arithmetic_intensity", "xla_entry_arithmetic_intensity"),
+    ("roofline_utilization_est", "xla_entry_roofline_utilization"),
+)
+
+
+def _cost_dict(compiled) -> Optional[Dict[str, float]]:
+    """Normalize ``Compiled.cost_analysis()`` across jax versions: 0.4.x
+    returns a list with one dict per program, newer versions a dict."""
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    return ca if isinstance(ca, dict) else None
+
+
+def capture(entry: str, compiled, backend: Optional[str] = None) -> Optional[Dict[str, Any]]:
+    """Read the cost/memory analyses off a freshly compiled (or AOT-loaded)
+    executable, derive the roofline estimate, store + mirror the record.
+    Returns the record, or None when this backend/jaxlib cannot produce
+    one (counted, never raised — cost capture is an observer)."""
+    try:
+        if backend is None:
+            import jax
+
+            backend = jax.default_backend()
+        ca = _cost_dict(compiled)
+        if not ca:
+            raise ValueError("empty cost_analysis")
+        flops = float(ca.get("flops", 0.0))
+        bytes_accessed = float(ca.get("bytes accessed", 0.0))
+        transcendentals = float(ca.get("transcendentals", 0.0))
+        record: Dict[str, Any] = {
+            "schema": SCHEMA_VERSION,
+            "backend": backend,
+            "flops": flops,
+            "bytes_accessed": bytes_accessed,
+            "transcendentals": transcendentals,
+        }
+        ma = None
+        try:
+            ma = compiled.memory_analysis()
+        except Exception:  # noqa: BLE001 — memory stats are optional
+            pass
+        if ma is not None:
+            arg_b = int(getattr(ma, "argument_size_in_bytes", 0))
+            out_b = int(getattr(ma, "output_size_in_bytes", 0))
+            tmp_b = int(getattr(ma, "temp_size_in_bytes", 0))
+            alias_b = int(getattr(ma, "alias_size_in_bytes", 0))
+            record.update(
+                argument_bytes=arg_b,
+                output_bytes=out_b,
+                temp_bytes=tmp_b,
+                alias_bytes=alias_b,
+                # live-at-once estimate: args + outputs + scratch, minus
+                # the donated (aliased) overlap counted twice
+                peak_memory_bytes=max(arg_b + out_b + tmp_b - alias_b, 0),
+            )
+        return ingest(entry, _roofline(record))
+    except Exception:  # noqa: BLE001 — capture must never fail a compile
+        REGISTRY.inc("xla_cost_capture_failures_total", entry=entry)
+        return None
+
+
+def _roofline(record: Dict[str, Any]) -> Dict[str, Any]:
+    """Derive intensity + attainable-fraction fields from a raw record."""
+    peaks = backend_peaks(record.get("backend", "default"))
+    flops = float(record.get("flops", 0.0))
+    bytes_accessed = float(record.get("bytes_accessed", 0.0))
+    intensity = flops / bytes_accessed if bytes_accessed > 0 else 0.0
+    ridge = peaks["flops_per_s"] / peaks["bytes_per_s"]
+    attainable = min(peaks["flops_per_s"], intensity * peaks["bytes_per_s"])
+    record.update(
+        arithmetic_intensity=round(intensity, 4),
+        ridge_intensity=round(ridge, 4),
+        roofline_utilization_est=(
+            round(attainable / peaks["flops_per_s"], 6)
+            if peaks["flops_per_s"] > 0
+            else 0.0
+        ),
+        bound="compute" if intensity >= ridge else "memory",
+        peak_flops_per_s=peaks["flops_per_s"],
+        peak_bytes_per_s=peaks["bytes_per_s"],
+    )
+    return record
+
+
+def ingest(entry: str, record: Dict[str, Any]) -> Dict[str, Any]:
+    """Store a (possibly disk-memoized) cost record for ``entry`` and
+    mirror the numeric headline fields as registry gauges. The compile
+    cache calls this on warm processes with the record it persisted at
+    compile time — XLA:CPU marks some hot entries unserializable, so a
+    warm chunk never re-holds the ``Compiled`` the analysis came from."""
+    if (
+        record.get("schema") != SCHEMA_VERSION
+        or "roofline_utilization_est" not in record
+    ):
+        # stale memo from an older field layout, or a raw flops/bytes
+        # record that never went through capture(): (re-)derive the
+        # intensity/roofline fields against the current peak table
+        record = _roofline(dict(record, schema=SCHEMA_VERSION))
+    with _lock:
+        _COSTS[entry] = record
+    for key, gauge in _GAUGES:
+        if key in record:
+            REGISTRY.set_gauge(gauge, float(record[key]), entry=entry)
+    return record
+
+
+def get(entry: str) -> Optional[Dict[str, Any]]:
+    with _lock:
+        rec = _COSTS.get(entry)
+    return dict(rec) if rec is not None else None
+
+
+def device_costs_block() -> Dict[str, Any]:
+    """The ``obs.device_costs`` block: every captured entry's record plus
+    the peak table the roofline estimates were computed against. Empty
+    ``entries`` when nothing compiled through the cache yet (or the
+    compile cache is disabled — capture rides its custody of Compiled)."""
+    with _lock:
+        entries = {k: dict(v) for k, v in sorted(_COSTS.items())}
+    backends = sorted({v.get("backend", "default") for v in entries.values()})
+    return {
+        "schema": SCHEMA_VERSION,
+        "entries": entries,
+        "peaks": {b: backend_peaks(b) for b in backends},
+    }
+
+
+def reset_for_testing() -> None:
+    with _lock:
+        _COSTS.clear()
+    for _key, gauge in _GAUGES:
+        REGISTRY.clear_metric(gauge)
+    REGISTRY.clear_metric("xla_cost_capture_failures_total")

@@ -314,9 +314,16 @@ def to_prometheus(snapshot: Optional[Snapshot] = None) -> str:
 
 def serve_metrics_http(port: int, host: str = "127.0.0.1"):
     """Start a daemon-thread HTTP server exposing ``/metrics`` (Prometheus
-    text) and ``/metrics.json`` (the structured snapshot). Returns the
-    server; callers ``.shutdown()`` it on exit. Port 0 picks a free port
-    (read it back from ``server.server_address[1]`` — tests do)."""
+    text) and ``/metrics.json`` (the structured snapshot).
+
+    Port 0 binds an EPHEMERAL port — the supported spelling for tests and
+    multi-instance runs, which were colliding on fixed ports; read the
+    actually-bound port back from ``server.port`` (it also surfaces in
+    the serve stats ``obs.metrics_port``). Returns the server; callers
+    ``.close()`` it on exit — :meth:`close` is the graceful shutdown:
+    ``shutdown()`` stops the serve loop AND ``server_close()`` releases
+    the listening socket, so the port is immediately rebindable (bare
+    ``shutdown()``, the old contract, leaked the socket until GC)."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     class Handler(BaseHTTPRequestHandler):
@@ -339,7 +346,26 @@ def serve_metrics_http(port: int, host: str = "127.0.0.1"):
         def log_message(self, *args):  # noqa: D102 — silence per-scrape spam
             pass
 
-    server = ThreadingHTTPServer((host, port), Handler)
+    class MetricsServer(ThreadingHTTPServer):
+        #: in-flight scrape threads must not block process exit
+        daemon_threads = True
+
+        @property
+        def port(self) -> int:
+            """The BOUND port (== the requested one unless it was 0)."""
+            return self.server_address[1]
+
+        def close(self) -> None:
+            self.shutdown()
+            self.server_close()
+
+        def __enter__(self) -> "MetricsServer":
+            return self
+
+        def __exit__(self, *exc) -> None:
+            self.close()
+
+    server = MetricsServer((host, port), Handler)
     threading.Thread(
         target=server.serve_forever, name="obs-metrics-http", daemon=True
     ).start()

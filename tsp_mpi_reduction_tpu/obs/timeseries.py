@@ -14,6 +14,7 @@ The series flushes into ``BnBResult.series`` → ``bnb_solve.py`` /
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List, Optional
 
 from . import enabled as _obs_enabled
@@ -29,10 +30,31 @@ COLUMNS = (
     "spill_to_device", # bytes refilled device-ward by this iteration
     "incumbent",       # best tour cost so far
     "lb_floor",        # certified lower-bound floor (root/resume clamp)
+    "reservoir",       # rows parked in the host spill reservoir — with
+                       # `frontier` this is the TOTAL open work, the
+                       # signal that separates a draining proof phase
+                       # from a wedged search (obs.anomaly)
 )
 
 
 class StepSampler:
+    #: slotted: the per-dispatch hot path touches five attributes; slot
+    #: access keeps its in-situ footprint (the cost that matters — the
+    #: hook runs cold-cache between jax dispatches) at the floor
+    __slots__ = (
+        "capacity", "_rows", "_total", "row_bytes", "frontier_layout",
+        "sentinel",
+    )
+
+    #: native self-meter (class-level, None = off): when TSP_BENCH=obs
+    #: prices the telemetry it sets this to a one-element ``[ns]`` list
+    #: and ``sample`` accumulates its own inclusive time into it. A
+    #: wrapper-based meter is NOT equivalent here: the wrapping frame +
+    #: argument re-packing costs ~1.5 us per call in situ — most of the
+    #: budget it is supposed to measure — while this is one is-None
+    #: check when off and two ``perf_counter_ns`` calls when on.
+    METER_NS: Optional[List[int]] = None
+
     def __init__(self, capacity: int = 512):
         if capacity < 1:
             raise ValueError(f"sampler capacity must be >= 1, got {capacity}")
@@ -46,6 +68,12 @@ class StepSampler:
         self.row_bytes: Optional[int] = None
         #: engine row-layout version the bytes were measured under
         self.frontier_layout: Optional[int] = None
+        #: optional attached ``obs.anomaly.StallSentinel``: when set,
+        #: sample() hands it the ring to batch-consume once per full
+        #: window (``StallSentinel.consume``) — per dispatch the
+        #: sentinel costs one compare, not a second Python call
+        #: (measured on the TSP_BENCH=obs <= 2% budget)
+        self.sentinel: Optional[Any] = None
 
     @classmethod
     def maybe(cls, capacity: int = 512) -> Optional["StepSampler"]:
@@ -56,7 +84,6 @@ class StepSampler:
 
     def sample(
         self,
-        *,
         step: int,
         wall_s: float,
         nodes: int,
@@ -66,18 +93,35 @@ class StepSampler:
         spill_to_device: int = 0,
         incumbent: float = float("inf"),
         lb_floor: float = float("-inf"),
+        reservoir: int = 0,
     ) -> None:
         # hot path (once per host-loop iteration): store raw values only;
-        # all rounding/JSON-sanitizing happens once, in series()
+        # all rounding/JSON-sanitizing happens once, in series(). The
+        # solver calls this POSITIONALLY — a 9-kwarg call costs ~1 us
+        # more than positional in situ (dict build + unpack, cold-cache),
+        # which is real money against the TSP_BENCH=obs <= 2% budget.
+        m = StepSampler.METER_NS
+        if m is not None:
+            t_meter = time.perf_counter_ns()
+        rows = self._rows
         row = (
             step, wall_s, nodes, nodes_per_s, frontier,
-            spill_to_host, spill_to_device, incumbent, lb_floor,
+            spill_to_host, spill_to_device, incumbent, lb_floor, reservoir,
         )
-        if len(self._rows) < self.capacity:
-            self._rows.append(row)
+        if len(rows) < self.capacity:
+            rows.append(row)
         else:
-            self._rows[self._total % self.capacity] = row
-        self._total += 1
+            rows[self._total % self.capacity] = row
+        total = self._total + 1
+        self._total = total
+        # sentinel rides the ring this sampler already keeps: one compare
+        # per dispatch here, one batch consume per full window there — a
+        # second per-dispatch Python call was ~half the telemetry budget
+        sn = self.sentinel
+        if sn is not None and total - sn.consumed >= sn.window:
+            sn.consume(self)
+        if m is not None:
+            m[0] += time.perf_counter_ns() - t_meter
 
     def __len__(self) -> int:
         return len(self._rows)
@@ -100,7 +144,7 @@ class StepSampler:
             [
                 int(r[0]), round(float(r[1]), 6), int(r[2]),
                 round(float(r[3]), 3), int(r[4]), int(r[5]), int(r[6]),
-                _finite(r[7]), _finite(r[8]),
+                _finite(r[7]), _finite(r[8]), int(r[9]),
             ]
             for r in raw
         ]

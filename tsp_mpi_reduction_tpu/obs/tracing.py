@@ -49,6 +49,40 @@ from . import enabled as _obs_enabled
 #: (trace_id, span_id) — the cross-thread propagation token
 SpanContext = Tuple[str, str]
 
+#: the cross-PROCESS propagation contract (ISSUE 9): a parent process
+#: stamps ``TSP_TRACE_PARENT=<trace_id>:<span_id>`` into a child's env,
+#: and the child's driver opens its root span under that context — so a
+#: chunked campaign's N subprocesses (retries, fallback restores, compile
+#: phases included) reconstruct as ONE span tree instead of N trace
+#: islands. ``bnb_chunked.py`` stamps it per chunk; ``bnb_solve.py`` (and
+#: anything that calls :func:`parent_from_env`) honors it.
+ENV_PARENT = "TSP_TRACE_PARENT"
+
+_HEX = frozenset("0123456789abcdef")
+
+
+def parent_from_env() -> Optional[SpanContext]:
+    """Parse ``TSP_TRACE_PARENT`` into a SpanContext, or None when unset
+    or malformed (a garbled env var must degrade to a fresh root trace,
+    never crash a solver)."""
+    raw = os.environ.get(ENV_PARENT, "").strip().lower()
+    if not raw or ":" not in raw:
+        return None
+    trace_id, _, span_id = raw.partition(":")
+    if not trace_id or not span_id:
+        return None
+    if not (set(trace_id) <= _HEX and set(span_id) <= _HEX):
+        return None
+    return (trace_id, span_id)
+
+
+def format_parent(ctx: Optional[SpanContext]) -> Optional[str]:
+    """The env-var encoding of a context (None in, None out — callers
+    stamp the child env only when tracing is actually on)."""
+    if ctx is None:
+        return None
+    return f"{ctx[0]}:{ctx[1]}"
+
 
 def _new_id(nbytes: int = 8) -> str:
     return os.urandom(nbytes).hex()
@@ -396,6 +430,21 @@ def read_trace(path: str) -> List[Dict[str, Any]]:
                 continue
             if isinstance(rec, dict) and rec.get("type") == "span":
                 spans.append(rec)
+    return spans
+
+
+def read_traces(paths: List[str]) -> List[Dict[str, Any]]:
+    """Stitch several JSONL sinks into one span list (a chunked campaign
+    leaves the parent's spans and every chunk subprocess's spans in the
+    SAME file via append mode, but retries/relocated sinks can split them
+    — the reconstruction only needs the union; trace_ids do the rest).
+    Unreadable files are skipped like malformed lines."""
+    spans: List[Dict[str, Any]] = []
+    for path in paths:
+        try:
+            spans.extend(read_trace(path))
+        except OSError:
+            continue
     return spans
 
 

@@ -44,6 +44,7 @@ from typing import Dict, IO, Iterable, List, Optional
 
 import numpy as np
 
+from ..obs import slo as _slo
 from ..obs import tracing as _tracing
 from ..obs.metrics import REGISTRY as _REGISTRY
 from ..resilience.faults import TransientFault
@@ -79,6 +80,14 @@ class ServiceConfig:
     #: --metrics-port is given) — surfaced in the stats ``obs`` block so
     #: a log line names its own scrape target
     metrics_port: Optional[int] = None
+    #: per-tier latency objectives (ISSUE 9): tier -> {"target_ms",
+    #: "goal"}. Evaluated over THIS session's tier-labeled latency
+    #: histograms into the stats ``slo`` block (attainment + error-budget
+    #: burn rate — obs.slo). Empty dict = no objectives (block still
+    #: present, tiers listed unjudged).
+    slos: Dict[str, Dict[str, float]] = field(
+        default_factory=lambda: {k: dict(v) for k, v in _slo.DEFAULT_SLOS.items()}
+    )
     ladder: LadderConfig = field(default_factory=LadderConfig)
 
 
@@ -110,6 +119,10 @@ class SolveService:
         #: DELTA, so back-to-back sessions in one process (tests, bench
         #: legs, embedded services) stop seeing each other's recoveries
         self._health0 = HEALTH.snapshot()
+        #: latency-histogram baseline: the SLO window is this session
+        #: (same delta discipline as health — a prior session's misses
+        #: must not burn this session's error budget)
+        self._latency0 = _REGISTRY.snapshot(prefix="serve_request_seconds")
         self.responses = 0
         self.errors = 0
         self.deadline_misses = 0
@@ -225,7 +238,13 @@ class SolveService:
         _REGISTRY.inc("serve_responses_total", cache=provenance)
         if missed:
             _REGISTRY.inc("serve_deadline_misses_total")
-        _REGISTRY.observe("serve_request_seconds", latency_ms / 1000.0)
+        # tier-labeled: the SLO evaluator judges each rung against ITS
+        # objective (a greedy answer in 40 ms is healthy; a bnb one is
+        # suspicious). Tier values come from the fixed ladder set — never
+        # from request fields (graftlint R13 bounds label cardinality).
+        _REGISTRY.observe(
+            "serve_request_seconds", latency_ms / 1000.0, tier=tier
+        )
         with _tracing.span("respond"):
             return {
                 "id": req_id,
@@ -256,6 +275,18 @@ class SolveService:
         # locked snapshots (graftflow R9): request threads increment the
         # ladder counts and timer phases while this reporting path runs
         tier_counts, rung_failures = self.ladder.counts_snapshot()
+        # SLO verdicts over THIS session's tier-labeled latency
+        # histograms (delta vs the service-start snapshot): attainment
+        # against each tier's target + error-budget burn rate (obs.slo)
+        lat = _REGISTRY.delta(self._latency0, prefix="serve_request_seconds")
+        hists_by_tier = {
+            dict(key).get("tier", "?"): v
+            for key, v in lat.data.get(
+                "serve_request_seconds", {}
+            ).get("series", {}).items()
+            if isinstance(v, dict)
+        }
+        slo_block = _slo.evaluate(hists_by_tier, self.cfg.slos)
         return reporting.service_stats_json(
             responses=responses,
             errors=errors,
@@ -270,6 +301,7 @@ class SolveService:
             # (registry-backed delta; see resilience.health)
             health=HEALTH.delta_since(self._health0),
             compile_cache=perf_cache.stats_dict(),
+            slo=slo_block,
             obs=reporting.obs_block(
                 trace_path=_tracing.TRACER.path,
                 metrics_port=self.cfg.metrics_port,
@@ -459,11 +491,13 @@ def serve_cli(argv: Optional[List[str]] = None) -> int:
         from ..obs.metrics import serve_metrics_http
 
         try:
+            # port 0 = ephemeral (multi-instance runs stop colliding);
+            # the BOUND port is what the stats obs block reports
             metrics_server = serve_metrics_http(args.metrics_port)
         except OSError as e:
             print(f"error: cannot bind metrics port: {e}", file=sys.stderr)
             return 2
-        cfg.metrics_port = metrics_server.server_address[1]
+        cfg.metrics_port = metrics_server.port
         print(
             f"metrics: http://127.0.0.1:{cfg.metrics_port}/metrics",
             file=sys.stderr,
@@ -485,7 +519,9 @@ def serve_cli(argv: Optional[List[str]] = None) -> int:
             except (OSError, ValueError):
                 pass  # broken pipe / already closed: nothing left to save
             if metrics_server is not None:
-                metrics_server.shutdown()
+                # graceful: stop the loop AND release the socket, so a
+                # follow-up instance can rebind the port immediately
+                metrics_server.close()
     if args.stats:
         print(svc.stats_json(), file=sys.stderr)
     return 0

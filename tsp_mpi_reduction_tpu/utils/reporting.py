@@ -82,6 +82,7 @@ def obs_block(
     the optional-overhead half is enabled, and the per-entry compile phase
     attribution read back from the metrics registry — the single source
     of truth the bespoke builders now assemble FROM (ISSUE 6)."""
+    from ..obs import costs as obs_costs
     from ..obs import enabled as obs_enabled
     from ..perf.compile_cache import compile_phase_seconds
 
@@ -90,6 +91,11 @@ def obs_block(
         "trace": trace_path,
         "metrics_port": metrics_port,
         "compile_phases_s": compile_phase_seconds(),
+        # XLA cost attribution per compiled hot entry (obs.costs): flops,
+        # bytes accessed, peak memory, arithmetic intensity + roofline
+        # utilization estimate vs the per-backend peak table — captured
+        # at compile/AOT-load time, memoized on disk for warm processes
+        "device_costs": obs_costs.device_costs_block(),
     }
 
 
@@ -106,6 +112,7 @@ def service_stats_json(
     rung_failures: Optional[Dict[str, int]] = None,
     health: Optional[Dict] = None,
     compile_cache: Optional[Dict] = None,
+    slo: Optional[Dict] = None,
     obs: Optional[Dict] = None,
 ) -> str:
     """Machine-readable serve-layer counters (SpillStats-style): per-tier
@@ -131,6 +138,9 @@ def service_stats_json(
         "phases_s": phases_s or {},
         "health": health or {},
         "compile_cache": compile_cache or {},
+        # per-tier latency SLO verdicts (obs.slo): session-window
+        # attainment vs each tier's objective + error-budget burn rate
+        "slo": slo or {},
         "obs": obs or {},
     }
     return json.dumps(payload)
