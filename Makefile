@@ -14,7 +14,7 @@ PY ?= python
 CXX ?= g++
 CXXFLAGS ?= -O2 -std=c++11
 
-.PHONY: all lint chaos native oracle test test-fast bench bench-serve bench-faults bench-compile bench-obs bench-step bench-check run sweep goldens clean
+.PHONY: all lint chaos native oracle test test-fast bench bench-serve bench-faults bench-compile bench-obs bench-step bench-shard bench-check run sweep goldens clean
 
 all: lint native oracle chaos bench-check
 
@@ -97,6 +97,13 @@ bench-step:
 # -> BENCH_OBS.json
 bench-obs:
 	TSP_BENCH=obs $(PY) bench.py
+
+# rank-resolved telemetry bench (ISSUE 10): metered per-dispatch rank-hook
+# cost (<= 2%, serial-hook estimator) on a deliberately skewed 4-rank CPU
+# mesh + per-rank accounting coherence + starved-rank naming
+# -> BENCH_SHARD_OBS.json
+bench-shard:
+	TSP_BENCH=shard $(PY) bench.py
 
 # regression sentinel over bench_history.jsonl (ISSUE 9): every TSP_BENCH
 # run appends a fingerprinted record; this gate fails when a governed

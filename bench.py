@@ -1282,6 +1282,187 @@ def bench_obs() -> int:
     return 0 if artifact["ok"] else 1
 
 
+def bench_shard() -> int:
+    """Rank-resolved telemetry cost + skew evidence (ISSUE 10 acceptance).
+
+    A 4-virtual-rank CPU mesh runs a deliberately SKEWED sharded solve —
+    every root child seeded on rank 0 (``seed_mode="single-rank"``), ring
+    balance with a tiny transfer slab so diffusion is slow (the VERDICT
+    r4 stranded-rank regime) — with the rank-resolved telemetry layer
+    fully on. Two things are measured:
+
+    1. **Rank-hook cost** (the GATED figure, <= 2%): the serial time of
+       the whole per-dispatch rank hook — ``RankSampler.due``'s counter
+       compare, the once-per-window ``[R, K]`` stats-row collective +
+       readback, the ring append + starvation check, and the end-of-run
+       series/balance/gauge flushes — metered natively via
+       ``RankSampler.METER_NS`` at the solver's own call site plus
+       wrapped flush surfaces, over the solve's remaining wall. The
+       serial-hook estimator from the PR 9 obs bench, NOT a wall A/B:
+       back-to-back pair ratios of bit-identical solves swing
+       0.66x-1.31x on this host, so a 2% wall gate would read scheduler
+       noise.
+    2. **Skew evidence**: the run must emit ``rank_series`` +
+       ``rank_balance``, the per-rank sums must reconcile with the
+       aggregate counters (nodes, spill bytes each way), and the starved
+       rank(s) must be NAMED via ``rank_starvation`` events.
+
+    Emits ``BENCH_SHARD_OBS.json`` (path: ``TSP_BENCH_SHARD_OUT``) and
+    appends two governed history metrics (``shard_rank_obs_overhead``,
+    ``shard_rank_us_per_dispatch``). Exit 1 when any criterion fails."""
+    import statistics
+
+    from tsp_mpi_reduction_tpu.utils.backend import force_host_platform
+
+    ranks = int(os.environ.get("TSP_BENCH_SHARD_RANKS", "4"))
+    force_host_platform(ranks)
+
+    from tsp_mpi_reduction_tpu import obs
+    from tsp_mpi_reduction_tpu.models import branch_bound as bb
+    from tsp_mpi_reduction_tpu.obs import rankview as obs_rank
+    from tsp_mpi_reduction_tpu.parallel.mesh import make_rank_mesh
+    from tsp_mpi_reduction_tpu.resilience.checkpoint import write_json_atomic
+
+    reps = int(os.environ.get("TSP_BENCH_SHARD_REPS", "5"))
+    n = int(os.environ.get("TSP_BENCH_SHARD_N", "12"))
+    cap = int(os.environ.get("TSP_BENCH_SHARD_CAPACITY", "160"))
+    out_path = os.environ.get("TSP_BENCH_SHARD_OUT", "BENCH_SHARD_OBS.json")
+    rng = np.random.default_rng(77)
+    xy = rng.uniform(0, 100, (n, 2))
+    d = np.rint(np.hypot(*(xy[:, None] - xy[None, :]).transpose(2, 0, 1)) * 10)
+    mesh = make_rank_mesh(ranks)
+    # min-out + no MST pruning keeps frontier pressure high (spill traffic
+    # exists, so the per-rank byte attribution is exercised); single-rank
+    # seeding + ring balance is the measured stranded-rank configuration
+    kw = dict(
+        capacity_per_rank=cap, k=4, inner_steps=2, bound="min-out",
+        mst_prune=False, node_ascent=0, device_loop=False,
+        seed_mode="single-rank", balance="ring", transfer=4,
+        max_iters=2_000_000,
+    )
+    bb.solve_sharded(d, mesh, **kw)  # warm the XLA compiles
+
+    # the hook meter: the per-dispatch/per-window path self-times through
+    # the native METER_NS accumulator (billed at the solver's call site —
+    # the collective dispatch lives outside the sampler class); the cold
+    # once-per-solve flush surfaces are wrapped, where frame cost is noise
+    meter_ns = [0]
+    hook = {"s": 0.0}
+
+    def _metered(fn):
+        def wrapper(*a, **k):
+            t = time.perf_counter()
+            try:
+                return fn(*a, **k)
+            finally:
+                hook["s"] += time.perf_counter() - t
+        return wrapper
+
+    _patched = [
+        (obs_rank.RankSampler, "series"),      # end-of-solve flush
+        (obs_rank, "rank_balance"),            # imbalance accounting
+        (obs_rank, "fold_rank_view"),          # registry gauge export
+    ]
+    _saved = [(o, nm, getattr(o, nm)) for o, nm in _patched]
+
+    def _hook_s() -> float:
+        return hook["s"] + meter_ns[0] * 1e-9
+
+    walls, hooks = [], []
+    last = None
+    obs.set_enabled(True)
+    try:
+        obs_rank.RankSampler.METER_NS = meter_ns
+        for obj, name, fn in _saved:
+            setattr(obj, name, _metered(fn))
+        for _rep in range(reps):
+            h0 = _hook_s()
+            t0 = time.perf_counter()
+            res = bb.solve_sharded(d, mesh, **kw)
+            walls.append(time.perf_counter() - t0)
+            hooks.append(_hook_s() - h0)
+            last = res
+    finally:
+        obs_rank.RankSampler.METER_NS = None
+        for obj, name, fn in _saved:
+            setattr(obj, name, fn)
+        obs.set_enabled(None)
+
+    assert last is not None and last.proven_optimal
+    bal = last.rank_balance
+    # per-run self-normalizing estimator (host drift between runs cancels)
+    per_run_pct = sorted(
+        h / max(w - h, 1e-9) * 100.0 for w, h in zip(walls, hooks)
+    )
+    overhead_pct = statistics.median(per_run_pct)
+    hook_ms = statistics.median(hooks) * 1000.0
+    dispatches = int(last.series["samples_total"]) if last.series else 1
+    us_per_dispatch = hook_ms * 1000.0 / max(dispatches, 1)
+    gate_ok = overhead_pct <= 2.0
+    starve_events = [
+        e for e in (last.anomalies or {}).get("events", [])
+        if e.get("kind") == "rank_starvation"
+    ]
+    coherent = (
+        last.rank_series is not None
+        and bal is not None
+        and sum(bal["nodes_per_rank"]) == last.nodes_expanded
+        and sum(bal["spill_bytes_to_host_per_rank"]) == last.spill_bytes_to_host
+        and sum(bal["spill_bytes_to_device_per_rank"])
+        == last.spill_bytes_to_device
+    )
+    skew_named = bool(bal and bal["starved_ranks"]) and all(
+        "rank" in e for e in starve_events
+    )
+    ok = gate_ok and coherent and skew_named
+    artifact = {
+        "metric": "shard_rank_obs_overhead",
+        "unit": "pct",
+        "value": round(overhead_pct, 2),
+        "ranks": ranks,
+        "n": n,
+        "capacity_per_rank": cap,
+        "reps": reps,
+        "bnb": {
+            "wall_ms": round(statistics.median(walls) * 1000.0, 3),
+            "hook_ms": round(hook_ms, 3),
+            "overhead_pct": round(overhead_pct, 2),
+            "us_per_dispatch": round(us_per_dispatch, 3),
+            "dispatches": dispatches,
+            "rank_window": (
+                last.rank_series["window"] if last.rank_series else None
+            ),
+            "rank_series_rows": (
+                len(last.rank_series["rows"]) if last.rank_series else 0
+            ),
+            "estimator": "metered-hooks",
+            "acceptance_max_pct": 2.0,
+            "ok": gate_ok,
+        },
+        "rank_balance": bal,
+        "starvation_events": len(starve_events),
+        "starved_ranks": bal["starved_ranks"] if bal else [],
+        "coherent": coherent,
+        "ok": ok,
+    }
+    write_json_atomic(out_path, artifact)
+    print(json.dumps(artifact))
+    hist_cfg = {
+        "ranks": ranks, "n": n, "capacity_per_rank": cap, "reps": reps,
+        "seed_mode": kw["seed_mode"], "balance": kw["balance"],
+        "estimator": "metered-hooks",
+    }
+    _history_append("shard", artifact, config=hist_cfg)
+    # second governed series: marginal rank-hook cost per host dispatch
+    _history_append("shard", {
+        "metric": "shard_rank_us_per_dispatch",
+        "value": round(us_per_dispatch, 3),
+        "unit": "us",
+        "ok": ok,
+    }, config=hist_cfg)
+    return 0 if ok else 1
+
+
 def main() -> int:
     if os.environ.get("TSP_BENCH") == "compile-child":
         # one measured subprocess of the compile bench (selects its own
@@ -1300,6 +1481,9 @@ def main() -> int:
     if os.environ.get("TSP_BENCH") == "spill":
         # forces its own CPU virtual mesh — never probes the accelerator
         return bench_spill()
+    if os.environ.get("TSP_BENCH") == "shard":
+        # forces its own CPU virtual mesh — never probes the accelerator
+        return bench_shard()
     if os.environ.get("TSP_BENCH") == "faults":
         # host-side checkpoint IO — never probes the accelerator
         from tsp_mpi_reduction_tpu.utils.backend import select_backend

@@ -1325,6 +1325,102 @@ def test_r13_quiet_on_bounded_labels():
     assert rules_of(vs) == []
 
 
+def test_r13_range_loop_labels_are_bounded():
+    # rank ids drawn from range(num_ranks) are bounded by construction —
+    # the ISSUE 10 per-rank gauges (obs.rankview.fold_rank_view) must
+    # never trip the rule; enumerate(range(...)) and a str() wrap are
+    # the same set
+    vs = lint(
+        """
+        from tsp_mpi_reduction_tpu.obs.metrics import REGISTRY
+
+        def fold(balance, num_ranks):
+            for r in range(num_ranks):
+                REGISTRY.inc("bnb_rank_nodes_total", balance[r], rank=r)
+                REGISTRY.set_gauge("bnb_rank_occupancy", 1.0, rank=str(r))
+            for i, _w in enumerate(range(8)):
+                REGISTRY.inc("windows_total", idx=i)
+        """,
+        rules={"R13"},
+    )
+    assert rules_of(vs) == []
+
+
+def test_r13_non_range_rebind_strips_the_bounded_exemption():
+    # an inner loop re-binding a bounded name from an UNBOUNDED iterable
+    # makes it unbounded again — inside the inner loop's body AND after
+    # it (the loop var outlives the loop, holding the last request)
+    vs = lint(
+        """
+        from tsp_mpi_reduction_tpu.obs.metrics import REGISTRY
+
+        def fold(requests):
+            for r in range(4):
+                for r in requests:
+                    REGISTRY.inc("seen_total", rank=r)
+                REGISTRY.inc("after_total", rank=r)
+        """,
+        rules={"R13"},
+    )
+    assert rules_of(vs) == ["R13"] and len(vs) == 2
+    assert all("loop variable" in v.message for v in vs)
+
+
+def test_r13_strip_survives_inner_bounded_loop_exit():
+    # a non-range rebind of 'a' nested inside ANOTHER range loop: the
+    # inner range loop's exit must not resurrect 'a' as bounded (only a
+    # loop's OWN targets are restored on its exit)
+    vs = lint(
+        """
+        from tsp_mpi_reduction_tpu.obs.metrics import REGISTRY
+
+        def fold(reqs, na, nb):
+            for a in range(na):
+                for b in range(nb):
+                    for a in reqs:
+                        pass
+                REGISTRY.inc("x_total", rank=a)
+        """,
+        rules={"R13"},
+    )
+    assert rules_of(vs) == ["R13"] and len(vs) == 1
+
+
+def test_r13_range_over_data_size_is_not_bounded():
+    # range(len(requests)) / range(q.qsize()) are sized by DATA — the
+    # label set grows with traffic, so the range exemption must not
+    # apply (only configuration-shaped args: names/constants/attributes)
+    vs = lint(
+        """
+        from tsp_mpi_reduction_tpu.obs.metrics import REGISTRY
+
+        def record(requests, q):
+            for i in range(len(requests)):
+                REGISTRY.inc("seen_total", idx=i)
+            for j in range(q.qsize()):
+                REGISTRY.inc("queued_total", idx=j)
+        """,
+        rules={"R13"},
+    )
+    assert rules_of(vs) == ["R13"] and len(vs) == 2
+
+
+def test_r13_bounded_exemption_ends_with_the_range_loop():
+    vs = lint(
+        """
+        from tsp_mpi_reduction_tpu.obs.metrics import REGISTRY
+
+        def fold(items):
+            for r in range(4):
+                pass
+            for r in items:
+                REGISTRY.inc("seen_total", rank=r)
+        """,
+        rules={"R13"},
+    )
+    assert rules_of(vs) == ["R13"]
+
+
 def test_r13_value_kwarg_is_not_a_label():
     vs = lint(
         """

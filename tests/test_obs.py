@@ -463,6 +463,13 @@ def test_bnb_solve_payload_golden_schema():
     assert payload["series"]["frontier_layout"] >= 2
     assert payload["obs"]["enabled"] is True
     assert payload["balance"] is None  # single-rank runs report no scheme
+    # rank-resolved telemetry (ISSUE 10) is a sharded-solve artifact:
+    # single-rank payloads carry the keys with null values (obs_report
+    # --ranks errors loudly on such a payload instead of rendering an
+    # empty section; the sharded golden lives in test_rankview.py)
+    assert "rank_series" in payload and payload["rank_series"] is None
+    assert "rank_balance" in payload["obs"]
+    assert payload["obs"]["rank_balance"] is None
 
 
 # -- span-tree completeness over a real serve session --------------------------

@@ -1,0 +1,421 @@
+"""Rank-resolved observability (ISSUE 10): RankSampler, starvation
+sentinel, imbalance accounting, sharded-solver integration.
+
+Covers the sampler's window cadence + cumulative-to-delta bookkeeping,
+the once-per-episode ``rank_starvation`` contract (fires on entry after
+``patience`` windows, re-arms only on recovery), the ``rank_balance``
+block's math, the per-rank gauge export (rank labels from
+``range(num_ranks)`` — the R13-bounded set), the golden schema of the
+driver payload's ``rank_series`` / ``obs.rank_balance`` for a sharded
+run, the skewed-instance acceptance (starved rank NAMED), coherence of
+the per-rank accounting through injected ``spill.fetch`` faults, and
+``tools/obs_report.py --ranks`` (render + exit 2 on a payload without
+per-rank telemetry).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import pathlib
+
+import numpy as np
+import pytest
+
+from tsp_mpi_reduction_tpu import obs
+from tsp_mpi_reduction_tpu.models import branch_bound as bb
+from tsp_mpi_reduction_tpu.obs import anomaly, metrics, rankview, tracing
+from tsp_mpi_reduction_tpu.ops.distance import distance_matrix_np
+from tsp_mpi_reduction_tpu.parallel.mesh import make_rank_mesh
+from tsp_mpi_reduction_tpu.resilience import faults
+from tsp_mpi_reduction_tpu.resilience.health import HEALTH
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def _obs_clean(monkeypatch):
+    """Tracing unconfigured, obs override cleared, and a tight sampling
+    window (the integration solves run few dispatches)."""
+    monkeypatch.setenv(rankview.ENV_WINDOW, "2")
+    tracing.configure(None)
+    obs.set_enabled(None)
+    yield
+    tracing.configure(None)
+    obs.set_enabled(None)
+
+
+def _skewed_d(n=11, seed=33):
+    rng = np.random.default_rng(seed)
+    return np.rint(distance_matrix_np(rng.uniform(0, 100, (n, 2))) * 10)
+
+
+#: the measured stranded-rank configuration (VERDICT r4): every root
+#: child seeded on rank 0, ring balance with a tiny transfer slab so
+#: diffusion to the far ranks is slow — starvation MUST fire; the
+#: capacity is tight enough that ranks 0-1 spill (per-rank byte
+#: attribution exercised) while the proof still completes in ~2 s
+SKEW_KW = dict(
+    capacity_per_rank=128, k=4, inner_steps=2, bound="min-out",
+    mst_prune=False, node_ascent=0, device_loop=False,
+    seed_mode="single-rank", balance="ring", transfer=4,
+    max_iters=2_000_000,
+)
+
+
+# -- RankSampler unit ----------------------------------------------------------
+
+
+def test_rank_sampler_window_cadence_and_deltas():
+    s = rankview.RankSampler(num_ranks=2, capacity=8, window=4)
+    due = [s.due() for _ in range(9)]
+    # first dispatch samples (short runs get >= 1 row), then every 4th
+    assert due[0] is True
+    s.sample(1, (5, 0), (5, 0), (10, 0), (0, 0), (1, 0), (64, 0), (32, 0),
+             (7.0, float("inf")))
+    assert not s.pending()
+    assert s.due() is False and s.due() is False and s.due() is False
+    assert s.due() is True  # window of 4 dispatches complete
+    s.sample(5, (3, 2), (3, 1), (16, 4), (0, 1), (1, 2), (64, 96), (32, 40),
+             (8.0, 9.0))
+    out = s.series()
+    assert out["columns"] == list(rankview.RANK_COLUMNS)
+    assert out["ranks"] == 2 and out["window"] == 4
+    r0, r1 = out["rows"]
+    # cumulative inputs became per-window deltas
+    assert r0[out["columns"].index("nodes")] == [10, 0]
+    assert r1[out["columns"].index("nodes")] == [6, 4]
+    assert r1[out["columns"].index("spill_events")] == [0, 2]
+    assert r1[out["columns"].index("spill_to_host")] == [0, 96]
+    assert r1[out["columns"].index("spill_to_device")] == [0, 40]
+    # +inf best bound (drained rank) encodes as null
+    assert r0[out["columns"].index("best_bound")] == [7.0, None]
+    json.dumps(out)  # strict-JSON encodable
+
+
+def test_rank_sampler_ring_keeps_newest():
+    s = rankview.RankSampler(num_ranks=1, capacity=3, window=1)
+    for i in range(7):
+        s.due()
+        s.sample(i, (1,), (1,), (i,), (0,), (0,), (0,), (0,), (1.0,))
+    out = s.series()
+    assert out["samples_total"] == 7 and out["samples_dropped"] == 4
+    assert [r[0] for r in out["rows"]] == [4, 5, 6]  # oldest-first tail
+
+
+def test_rank_sampler_maybe_respects_tsp_obs_off():
+    obs.set_enabled(False)
+    assert rankview.RankSampler.maybe(4) is None
+    obs.set_enabled(True)
+    s = rankview.RankSampler.maybe(4)
+    assert s is not None and s.watch is not None
+    assert s.window == 2  # the fixture's ENV_WINDOW
+
+
+# -- starvation sentinel unit --------------------------------------------------
+
+
+def test_rank_starvation_fires_once_per_episode_and_rearms():
+    sen = anomaly.RankStarvationSentinel(4, starve_ratio=0.1, patience=2)
+    starved = ((10, 10, 10, 0), (40, 40, 40, 0))  # rank 3 at zero share
+    fed = ((10, 10, 10, 10), (30, 30, 30, 30))
+    fired = []
+    for step, (occ, nodes) in enumerate([
+        starved,   # streak 1: below patience, no fire
+        starved,   # streak 2: FIRES
+        starved,   # still starved: armed, no re-fire
+        fed,       # recovery: episode over, re-arms
+        starved,   # streak 1 again
+        starved,   # second episode FIRES
+    ]):
+        fired.extend(sen.observe_window(step, occ, nodes))
+    assert [e["step"] for e in fired] == [1, 5]
+    assert all(e["kind"] == "rank_starvation" and e["rank"] == 3
+               for e in fired)
+    assert sen.episodes_per_rank == [0, 0, 0, 2]
+    assert len(sen.events) == 2  # exactly once per episode
+
+
+def test_rank_starvation_quiet_on_drained_mesh_and_single_rank():
+    sen = anomaly.RankStarvationSentinel(4, patience=1)
+    # zero nodes everywhere = proof endgame, not starvation
+    assert sen.observe_window(1, (0, 0, 0, 0), (0, 0, 0, 0)) == []
+    solo = anomaly.RankStarvationSentinel(1, patience=1)
+    assert solo.observe_window(1, (5,), (100,)) == []
+    assert sen.events == [] and solo.events == []
+
+
+def test_rank_starvation_reaches_health_registry_and_summary():
+    reg = metrics.REGISTRY
+    before = reg.value("bnb_anomalies_total", kind="rank_starvation")
+    h0 = HEALTH.snapshot().get("anomaly_rank_starvation", 0)
+    sen = anomaly.RankStarvationSentinel(2, patience=1)
+    sen.observe_window(3, (9, 0), (50, 0))
+    assert reg.value("bnb_anomalies_total", kind="rank_starvation") == before + 1
+    assert HEALTH.snapshot()["anomaly_rank_starvation"] == h0 + 1
+    assert sen.summary() == {"events": sen.events, "fired": 1}
+
+
+def test_merge_summaries_orders_by_step_and_handles_none():
+    assert anomaly.merge_summaries(None, None) is None
+    stall = anomaly.StallSentinel(window=2)
+    rank = anomaly.RankStarvationSentinel(2, patience=1)
+    rank.observe_window(7, (5, 0), (40, 0))
+    stall.events.append({"kind": "lb_stagnation", "step": 3})
+    merged = anomaly.merge_summaries(stall, rank, None)
+    assert merged["fired"] == 2
+    assert [e["step"] for e in merged["events"]] == [3, 7]
+
+
+# -- rank_balance / gauge export -----------------------------------------------
+
+
+def test_rank_balance_math_and_straggler():
+    series = {
+        "columns": list(rankview.RANK_COLUMNS),
+        "rows": [
+            [0, [8, 2], [8, 2], [9, 1], [0, 0], [0, 0], [0, 0], [0, 0],
+             [1.0, 2.0]],
+            [2, [4, 2], [4, 2], [6, 2], [0, 0], [0, 0], [0, 0], [0, 0],
+             [1.0, 2.0]],
+        ],
+        "ranks": 2,
+    }
+    events = [{"kind": "rank_starvation", "rank": 1, "step": 2},
+              {"kind": "lb_stagnation", "step": 4}]
+    bal = rankview.rank_balance(
+        series, [15, 3], spill_events=[2, 0],
+        spill_bytes_to_host=[128, 0], spill_bytes_to_device=[64, 0],
+        reservoir=[1, 0], events=events,
+    )
+    assert bal["ranks"] == 2 and bal["nodes_total"] == 18
+    assert bal["straggler_rank"] == 0
+    assert bal["straggler_score"] == pytest.approx(15 / 9, abs=1e-3)
+    assert bal["nodes_max_min_ratio"] == pytest.approx(5.0)
+    assert bal["occupancy_mean"] == [6.0, 2.0]
+    assert bal["starved_ranks"] == [1] and bal["starvation_episodes"] == 1
+    assert bal["spill_bytes_to_host_per_rank"] == [128, 0]
+    json.dumps(bal)
+
+
+def test_rank_balance_zero_work_is_balanced_not_nan():
+    bal = rankview.rank_balance(None, [0, 0, 0])
+    assert bal["nodes_cv"] == 0.0 and bal["occupancy_cv"] == 0.0
+    assert bal["straggler_score"] == 0.0
+    json.dumps(bal)
+
+
+def test_fold_rank_view_exports_bounded_rank_gauges():
+    reg = metrics.REGISTRY
+    n0 = reg.value("bnb_rank_nodes_total", rank=1)
+    rankview.fold_rank_view({
+        "ranks": 2,
+        "nodes_per_rank": [10, 4],
+        "occupancy_mean": [3.5, 1.5],
+        "occupancy_cv": 0.4,
+        "nodes_cv": 0.3,
+        "straggler_score": 1.4,
+        "spill_events_per_rank": [2, 0],
+        "spill_bytes_to_host_per_rank": [256, 0],
+        "spill_bytes_to_device_per_rank": [128, 0],
+    })
+    assert reg.value("bnb_rank_nodes_total", rank=1) == n0 + 4
+    assert reg.value("bnb_rank_occupancy_mean", rank=0) == 3.5
+    assert reg.value("bnb_rank_spill_bytes_total",
+                     rank=0, direction="to_host") >= 256
+    assert reg.value("bnb_rank_straggler_score") == 1.4
+
+
+# -- sharded-solver integration ------------------------------------------------
+
+
+def _solve_skewed(n=11, **over):
+    kw = dict(SKEW_KW)
+    kw.update(over)
+    return bb.solve_sharded(_skewed_d(n), make_rank_mesh(4), **kw)
+
+
+def test_sharded_rank_series_schema_and_coherence():
+    res = _solve_skewed()
+    assert res.proven_optimal
+    rs, bal = res.rank_series, res.rank_balance
+    assert rs is not None and bal is not None
+    assert rs["columns"] == list(rankview.RANK_COLUMNS)
+    assert rs["ranks"] == 4 and rs["rows"]
+    cols = rs["columns"]
+    for row in rs["rows"]:
+        assert len(row) == len(cols)
+        for c in cols[1:]:
+            assert len(row[cols.index(c)]) == 4  # one entry per rank
+    # per-rank sums reconcile with the aggregate counters
+    assert sum(bal["nodes_per_rank"]) == res.nodes_expanded
+    assert bal["nodes_per_rank"] == [int(x) for x in res.nodes_per_rank]
+    assert sum(bal["spill_bytes_to_host_per_rank"]) == res.spill_bytes_to_host
+    assert (
+        sum(bal["spill_bytes_to_device_per_rank"])
+        == res.spill_bytes_to_device
+    )
+    assert sum(bal["spill_events_per_rank"]) == res.spill_events
+    # the series' window deltas sum to the totals too (no tail lost:
+    # the solver flushes a final pending sample at loop exit)
+    i_nodes = cols.index("nodes")
+    assert (
+        sum(sum(r[i_nodes]) for r in rs["rows"]) == res.nodes_expanded
+        or rs["samples_dropped"] > 0
+    )
+    json.dumps(rs), json.dumps(bal)
+
+
+def test_skewed_run_names_the_starved_rank():
+    res = _solve_skewed()
+    bal = res.rank_balance
+    starve = [e for e in res.anomalies["events"]
+              if e["kind"] == "rank_starvation"]
+    # the single-rank seed + slow ring diffusion MUST strand ranks far
+    # from rank 0 — and the verdict names them
+    assert starve, "skewed run fired no rank_starvation"
+    assert all("rank" in e and "window_nodes" in e for e in starve)
+    assert bal["starved_ranks"], "balance block names no starved rank"
+    assert set(bal["starved_ranks"]) == {e["rank"] for e in starve}
+    assert bal["starvation_episodes"] == len(starve)
+    # rank 0 held all seeds: it must be the straggler, not the starved
+    assert bal["straggler_rank"] == 0
+    assert 0 not in bal["starved_ranks"]
+    assert bal["nodes_cv"] > 0.1
+
+
+def test_rank_series_absent_under_tsp_obs_off():
+    obs.set_enabled(False)
+    res = _solve_skewed(max_iters=64)
+    assert res.rank_series is None and res.rank_balance is None
+    assert res.anomalies is None
+
+
+@pytest.mark.chaos
+def test_rank_stats_coherent_through_spill_fetch_faults():
+    """Injected transient spill.fetch faults (absorbed by the bounded
+    retry) must not desynchronize the per-rank accounting from the
+    aggregate counters — the chaos guarantee for the rank view."""
+    faults.clear()
+    try:
+        faults.configure("spill.fetch:raise,nth=2,count=2")
+        res = _solve_skewed()
+        hits = faults.registry().hits("spill.fetch")
+    finally:
+        faults.clear()
+    assert res.proven_optimal
+    assert hits > 2, "seam never crossed"
+    assert HEALTH.snapshot()["retries"] >= 1  # the faults were absorbed
+    bal = res.rank_balance
+    assert sum(bal["nodes_per_rank"]) == res.nodes_expanded
+    assert sum(bal["spill_bytes_to_host_per_rank"]) == res.spill_bytes_to_host
+    assert (
+        sum(bal["spill_bytes_to_device_per_rank"])
+        == res.spill_bytes_to_device
+    )
+    assert sum(bal["spill_events_per_rank"]) == res.spill_events
+
+
+# -- driver payload golden schema ----------------------------------------------
+
+RANK_SERIES_SCHEMA = {
+    "columns": list, "ranks": int, "window": int, "rows": list,
+    "samples_total": int, "samples_dropped": int,
+}
+
+RANK_BALANCE_SCHEMA = {
+    "ranks": int, "nodes_per_rank": list, "nodes_total": int,
+    "nodes_cv": float, "nodes_max_min_ratio": float,
+    "occupancy_mean": list, "occupancy_cv": float,
+    "straggler_rank": int, "straggler_score": float,
+    "starved_ranks": list, "starvation_episodes": int,
+    "spill_events_per_rank": list, "spill_bytes_to_host_per_rank": list,
+    "spill_bytes_to_device_per_rank": list, "reservoir_per_rank": list,
+}
+
+
+def _payload(res, inst):
+    spec = importlib.util.spec_from_file_location(
+        "bnb_solve", REPO / "tools" / "bnb_solve.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    class Args:
+        ranks = 4
+        bound = "min-out"
+        mst_kernel = "prim"
+        step_kernel = "reference"
+        push_order = "best-first"
+        push_block = 0
+        balance = "ring"
+
+    return mod.result_payload(res, inst, Args())
+
+
+def test_sharded_payload_golden_schema():
+    from tsp_mpi_reduction_tpu.utils import tsplib
+
+    inst = tsplib.resolve_instance("random:11:33")
+    res = bb.solve_sharded(
+        np.rint(inst.distance_matrix() * 10), make_rank_mesh(4), **SKEW_KW
+    )
+    payload = _payload(res, inst)
+    for key, typ in RANK_SERIES_SCHEMA.items():
+        assert key in payload["rank_series"], key
+        assert isinstance(payload["rank_series"][key], typ), key
+    bal = payload["obs"]["rank_balance"]
+    for key, typ in RANK_BALANCE_SCHEMA.items():
+        assert key in bal, key
+        assert isinstance(bal[key], typ), (key, type(bal[key]))
+    json.dumps(payload)  # one encodable JSON line, driver contract
+
+
+# -- obs_report --ranks --------------------------------------------------------
+
+
+def _obs_report():
+    spec = importlib.util.spec_from_file_location(
+        "obs_report", REPO / "tools" / "obs_report.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_obs_report_ranks_renders_heatmap(tmp_path, capsys):
+    res = _solve_skewed()
+    from tsp_mpi_reduction_tpu.utils import tsplib
+
+    inst = tsplib.resolve_instance("random:11:33")
+    path = tmp_path / "payload.json"
+    path.write_text(json.dumps(_payload(res, inst)))
+    mod = _obs_report()
+    rc = mod.main(["--ranks", str(path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "4 ranks" in out and "occupancy heatmap" in out
+    assert "straggler rank 0" in out
+    for r in range(4):
+        assert f"rank {r}" in out
+
+
+def test_obs_report_ranks_errors_on_single_rank_payload(tmp_path, capsys):
+    # a payload WITHOUT rank_series (single-rank run) must exit 2 with a
+    # clear message — not render an empty healthy-looking section
+    path = tmp_path / "single.json"
+    path.write_text(json.dumps({"instance": "x", "rank_series": None}))
+    mod = _obs_report()
+    rc = mod.main(["--ranks", str(path)])
+    err = capsys.readouterr().err
+    assert rc == 2
+    assert "rank_series" in err and "single-rank" in err
+
+
+def test_shard_bench_metrics_are_governed():
+    from tsp_mpi_reduction_tpu.obs.bench_history import DEFAULT_RULES
+
+    for name in ("shard_rank_obs_overhead", "shard_rank_us_per_dispatch"):
+        rule = DEFAULT_RULES[name]
+        assert rule.direction == "lower"
+        assert rule.abs_threshold > 0  # percent/us near zero: absolute band

@@ -4,8 +4,11 @@ Two subcommands (default: ``check``):
 
 ``check``   read ``bench_history.jsonl``, evaluate every governed metric's
             newest sample against its prior samples (median + MAD model,
-            per-metric direction/threshold/min-samples — see
-            ``obs.bench_history``), print a verdict table, exit 1 on any
+            per-metric direction/threshold/min-samples — the governed
+            table is ``obs.bench_history.DEFAULT_RULES``; it includes the
+            rank-resolved telemetry gates ``shard_rank_obs_overhead`` /
+            ``shard_rank_us_per_dispatch`` from ``TSP_BENCH=shard``),
+            print a verdict table, exit 1 on any
             regression. Below min-samples a metric reports
             ``insufficient`` and never fails — a fresh clone passes while
             history accretes. ``make bench-check`` runs this and the

@@ -105,12 +105,23 @@ def result_payload(res, inst, args) -> dict:
         # trajectory; null under TSP_OBS=off
         "series": res.series,
         # stall-sentinel verdicts (obs.anomaly): nodes/sec collapse,
-        # certified-LB stagnation — each was also fired as a health
-        # event at detection time; null under TSP_OBS=off
+        # certified-LB stagnation, rank starvation — each was also fired
+        # as a health event at detection time; null under TSP_OBS=off
         "anomalies": res.anomalies,
+        # rank-resolved telemetry (obs.rankview, ISSUE 10): per-rank
+        # occupancy/alive/nodes/reservoir/spill/best-bound windows;
+        # null for single-rank solves and under TSP_OBS=off —
+        # tools/obs_report.py --ranks renders it (and errors loudly on
+        # a payload without it)
+        "rank_series": getattr(res, "rank_series", None),
         # obs layer provenance: trace sink (TSP_TRACE), enabled flag,
-        # per-entry compile-phase attribution from the metrics registry
-        "obs": _reporting.obs_block(trace_path=_tracing.TRACER.path),
+        # per-entry compile-phase attribution from the metrics registry,
+        # plus the rank imbalance accounting (occupancy CV, straggler
+        # score, starved ranks) for sharded runs
+        "obs": {
+            **_reporting.obs_block(trace_path=_tracing.TRACER.path),
+            "rank_balance": getattr(res, "rank_balance", None),
+        },
     }
 
 

@@ -33,14 +33,23 @@ from tsp_mpi_reduction_tpu.obs import tracing  # noqa: E402
 _SPARK = "▁▂▃▄▅▆▇█"
 
 
-def _sparkline(values: List[float], width: int = 48) -> str:
+def _sparkline(
+    values: List[float],
+    width: int = 48,
+    lo: Optional[float] = None,
+    hi: Optional[float] = None,
+) -> str:
+    """Coarse text sparkline; pass ``lo``/``hi`` to pin the scale (the
+    rank heatmap renders every rank against one shared max so row
+    heights are comparable)."""
     vals = [v for v in values if v is not None]
     if not vals:
         return ""
     if len(vals) > width:  # decimate to the display width, preserving shape
         stride = len(vals) / width
         vals = [vals[int(i * stride)] for i in range(width)]
-    lo, hi = min(vals), max(vals)
+    lo = min(vals) if lo is None else lo
+    hi = max(vals) if hi is None else hi
     span = (hi - lo) or 1.0
     return "".join(
         _SPARK[min(int((v - lo) / span * (len(_SPARK) - 1)), len(_SPARK) - 1)]
@@ -150,6 +159,89 @@ def render_series(path: str) -> str:
     return "\n".join(out)
 
 
+def render_ranks(path: str) -> str:
+    """Render a driver payload's ``rank_series`` (ISSUE 10): per-rank
+    totals, the imbalance/straggler verdict from ``obs.rank_balance``,
+    and an occupancy heatmap (one sparkline row per rank, all rows
+    normalized against the same global max so height is comparable
+    across ranks — a starved rank reads as a flat-bottom row).
+
+    A payload WITHOUT a rank series — a single-rank run, or
+    ``TSP_OBS=off`` — is an error, not an empty section: the caller
+    explicitly asked for rank attribution, and rendering a
+    healthy-looking nothing would hide that the run never produced it
+    (same posture as the missing ``--trace`` sink)."""
+    out: List[str] = []
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                doc = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            series = doc.get("rank_series") if isinstance(doc, dict) else None
+            if not series or not series.get("rows"):
+                continue
+            cols, rows = series["columns"], series["rows"]
+            ranks = int(series["ranks"])
+            name = doc.get("instance", "?")
+            out.append(
+                f"== ranks {path} [{name}]: {ranks} ranks x "
+                f"{series['samples_total']} windows (window "
+                f"{series['window']}, {series['samples_dropped']} rolled "
+                "off) =="
+            )
+            bal = (doc.get("obs") or {}).get("rank_balance")
+            if bal:
+                out.append(
+                    f"  balance: nodes_cv {bal['nodes_cv']}  "
+                    f"occupancy_cv {bal['occupancy_cv']}  "
+                    f"straggler rank {bal['straggler_rank']} "
+                    f"(score {bal['straggler_score']})  "
+                    f"starved {bal['starved_ranks']} "
+                    f"({bal['starvation_episodes']} episodes)"
+                )
+            i_occ = cols.index("occupancy")
+
+            def _tot(bal_key, col):
+                # whole-run totals come from the AUTHORITATIVE balance
+                # block when present; the ring rows only cover what the
+                # ring still holds, so summing them under-reports any
+                # run long enough to roll samples off
+                if bal and bal_key in bal:
+                    return [int(v) for v in bal[bal_key]]
+                i = cols.index(col)
+                return [sum(r[i][rk] for r in rows) for rk in range(ranks)]
+
+            node_tot = _tot("nodes_per_rank", "nodes")
+            ev_tot = _tot("spill_events_per_rank", "spill_events")
+            bh_tot = _tot("spill_bytes_to_host_per_rank", "spill_to_host")
+            bd_tot = _tot("spill_bytes_to_device_per_rank", "spill_to_device")
+            total = max(sum(node_tot), 1)
+            for rk in range(ranks):
+                out.append(
+                    f"  rank {rk}: nodes {node_tot[rk]} "
+                    f"({node_tot[rk] / total * 100:.1f}%)  "
+                    f"spill {ev_tot[rk]} ev / {bh_tot[rk]} B down / "
+                    f"{bd_tot[rk]} B up"
+                )
+            # the heatmap: per-rank occupancy over time, shared scale
+            occ = [[r[i_occ][rk] for r in rows] for rk in range(ranks)]
+            hi = max((v for row in occ for v in row), default=0) or 1
+            out.append("  occupancy heatmap (time ->):")
+            for rk in range(ranks):
+                out.append(f"    rank {rk} {_sparkline(occ[rk], lo=0, hi=hi)}")
+    if not out:
+        raise ValueError(
+            f"no rank_series block in {path!r} — single-rank runs (and "
+            "TSP_OBS=off runs) carry no per-rank telemetry; re-run with "
+            "--ranks >= 2 and TSP_OBS=on to produce one"
+        )
+    return "\n".join(out)
+
+
 def render_metrics(path: str, top: int = 20) -> str:
     with open(path, encoding="utf-8") as fh:
         data = json.load(fh)
@@ -179,21 +271,30 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "campaign traces)")
     ap.add_argument("--series", default=None,
                     help="bnb_solve JSON (line file ok) with a series block")
+    ap.add_argument("--ranks", default=None,
+                    help="bnb_solve JSON (line file ok) with a rank_series "
+                    "block (sharded runs) — per-rank totals, imbalance "
+                    "verdict, occupancy heatmap; errors (exit 2) when the "
+                    "payload carries no per-rank telemetry")
     ap.add_argument("--metrics", default=None, help="/metrics.json dump")
     ap.add_argument("--limit", type=int, default=None,
                     help="max traces to render")
     args = ap.parse_args(argv)
-    if not (args.trace or args.series or args.metrics):
-        ap.error("give at least one of --trace / --series / --metrics")
+    if not (args.trace or args.series or args.ranks or args.metrics):
+        ap.error(
+            "give at least one of --trace / --series / --ranks / --metrics"
+        )
     sections = []
     try:
         if args.trace:
             sections.append(render_trace(args.trace, args.limit))
         if args.series:
             sections.append(render_series(args.series))
+        if args.ranks:
+            sections.append(render_ranks(args.ranks))
         if args.metrics:
             sections.append(render_metrics(args.metrics))
-    except OSError as e:
+    except (OSError, ValueError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
     try:

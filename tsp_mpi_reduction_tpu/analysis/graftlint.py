@@ -434,6 +434,10 @@ class _FileLinter(ast.NodeVisitor):
         self.tainted: Set[str] = set()  # assigned raw from jitted callees
         self.buffer_names: Set[str] = set()  # assigned from io.BytesIO etc.
         self.loop_targets: Set[str] = set()  # names bound by enclosing fors (R13)
+        #: the subset of loop_targets bound by ``for x in range(...)``
+        #: loops — bounded cardinality by construction (R13 exemption:
+        #: rank ids from range(num_ranks) can never outgrow the mesh)
+        self.bounded_targets: Set[str] = set()
         #: does the current scope os.replace-publish (the atomic pattern)?
         self.atomic_scope = self._scope_is_atomic(tree)
 
@@ -479,6 +483,7 @@ class _FileLinter(ast.NodeVisitor):
             self.atomic_scope,
             self.jit_scope,
             self.loop_targets,
+            self.bounded_targets,
         )
         self.scope.append(node.name)
         self.def_lines.append(node.lineno)
@@ -497,6 +502,7 @@ class _FileLinter(ast.NodeVisitor):
         self.tainted = set()
         self.buffer_names = set()
         self.loop_targets = set()
+        self.bounded_targets = set()
         self.atomic_scope = self._scope_is_atomic(node)
         self._check_r5(node)
         self._check_r7_def(node)
@@ -515,6 +521,7 @@ class _FileLinter(ast.NodeVisitor):
             self.atomic_scope,
             self.jit_scope,
             self.loop_targets,
+            self.bounded_targets,
         ) = saved
 
     # -- loops -------------------------------------------------------------
@@ -523,13 +530,67 @@ class _FileLinter(ast.NodeVisitor):
         self._check_r4(node)
         self.loop_depth += 1
         self.for_depth += 1
-        # R13: names this loop binds are loop-variable labels in its body
+        # R13: names this loop binds are loop-variable labels in its body;
+        # a ``for x in range(...)`` target is BOUNDED (the label set can
+        # never outgrow the range — rank ids from range(num_ranks) are
+        # the canonical case), any other iterable is not, and an inner
+        # non-range loop re-binding a bounded name strips the exemption
         saved_targets = set(self.loop_targets)
-        self.loop_targets.update(self._target_names([node.target]))
+        saved_bounded = set(self.bounded_targets)
+        targets = self._target_names([node.target])
+        self.loop_targets.update(targets)
+        if self._iter_is_bounded(node.iter):
+            self.bounded_targets.update(targets)
+        else:
+            self.bounded_targets.difference_update(targets)
         self.generic_visit(node)
         self.loop_targets = saved_targets
+        # On exit, adjust only THIS loop's own targets: a range loop's
+        # revert to their pre-loop status (conservative — the var holds
+        # the last range value, but the pre-loop binding is what the
+        # rest of the scope was written against), a non-range loop's
+        # stay STRIPPED (the name still holds an element of the
+        # unbounded iterable; Python loop vars outlive the loop). Strips
+        # of OTHER names made inside the body persist — a wholesale
+        # snapshot restore would resurrect a name an inner
+        # `for r in requests:` rebind had stripped.
+        bounded_iter = self._iter_is_bounded(node.iter)
+        for t in targets:
+            if bounded_iter and t in saved_bounded:
+                self.bounded_targets.add(t)
+            else:
+                self.bounded_targets.discard(t)
         self.for_depth -= 1
         self.loop_depth -= 1
+
+    @staticmethod
+    def _iter_is_bounded(it: ast.AST) -> bool:
+        """Is this for-loop iterable a bounded label source? ``range(...)``
+        (and ``enumerate(range(...))``) over configuration-shaped
+        arguments — names, constants, attributes, arithmetic thereof
+        (``range(num_ranks)``, ``range(2 * R)``). A range whose argument
+        embeds a CALL — ``range(len(requests))``,
+        ``range(queue.qsize())`` — is sized by DATA, so its label set
+        grows with the process's traffic: exactly the unbounded
+        cardinality R13 exists to catch, not exempt."""
+        if (
+            isinstance(it, ast.Call)
+            and isinstance(it.func, ast.Name)
+            and it.func.id == "enumerate"
+            and it.args
+        ):
+            it = it.args[0]
+        if not (
+            isinstance(it, ast.Call)
+            and isinstance(it.func, ast.Name)
+            and it.func.id == "range"
+        ):
+            return False
+        return not any(
+            isinstance(sub, ast.Call)
+            for arg in it.args
+            for sub in ast.walk(arg)
+        )
 
     def visit_While(self, node: ast.While) -> None:
         self._check_r3_test(node)
@@ -715,7 +776,13 @@ class _FileLinter(ast.NodeVisitor):
         value = self._unwrap_str_call(value)
         if isinstance(value, ast.JoinedStr):
             return "an f-string label mints a new series per formatted value"
-        if isinstance(value, ast.Name) and value.id in self.loop_targets:
+        if (
+            isinstance(value, ast.Name)
+            and value.id in self.loop_targets
+            # range()-bound loop vars are a bounded set (per-rank gauges
+            # labeled from range(num_ranks) must never trip the rule)
+            and value.id not in self.bounded_targets
+        ):
             return (
                 f"loop variable {value.id!r} as a label mints one series "
                 "per iteration"

@@ -51,6 +51,7 @@ import numpy as np
 from ..analysis import contracts as _contracts
 from ..obs import anomaly as _obs_anomaly
 from ..obs import metrics as _obs_metrics
+from ..obs import rankview as _obs_rank
 from ..obs import timeseries as _obs_series
 from ..obs import tracing as _obs_tracing
 from ..perf import compile_cache as _perf_cache
@@ -396,8 +397,19 @@ class BnBResult:
     #: stall-sentinel verdicts (obs.anomaly.StallSentinel: nodes/sec
     #: collapse, certified-LB stagnation — each also fired as a health
     #: event + registry counter at detection time); None under
-    #: ``TSP_OBS=off``
+    #: ``TSP_OBS=off``. Sharded runs merge ``rank_starvation`` events
+    #: (obs.anomaly.RankStarvationSentinel) onto the same timeline
     anomalies: Optional[dict] = None
+    #: per-rank telemetry ring (obs.rankview.RankSampler): occupancy /
+    #: alive rows / nodes / reservoir depth / spill events+bytes each
+    #: way / best open bound per rank, one row per sampling window;
+    #: sharded solves only, None under ``TSP_OBS=off``
+    rank_series: Optional[dict] = None
+    #: imbalance accounting over the whole run (obs.rankview.rank_balance:
+    #: occupancy CV, straggler rank/score, starved ranks + episode
+    #: counts, per-rank spill totals); sharded solves only, None under
+    #: ``TSP_OBS=off``
+    rank_balance: Optional[dict] = None
 
 
 def nearest_neighbor_tour(d: np.ndarray, start: int = 0) -> np.ndarray:
@@ -2938,6 +2950,14 @@ def solve_sharded(
     # accounting object (BnBResult reports whole-run totals).
     spill_stats = SpillStats()
     reservoirs = [_Reservoir(stats=spill_stats) for _ in range(num_ranks)]
+    # per-rank spill attribution (ISSUE 10): spill_refill already walks
+    # ranks one by one, so splitting the SpillStats totals per rank costs
+    # three host int adds per event — the rank-resolved series and the
+    # obs.rank_balance block read these, and their sums must equal the
+    # aggregate counters (regression-tested)
+    rank_spill_events = np.zeros(num_ranks, np.int64)
+    rank_spill_to_host = np.zeros(num_ranks, np.int64)
+    rank_spill_to_device = np.zeros(num_ranks, np.int64)
     if resumed_reservoir is not None and len(resumed_reservoir):
         # a resumed checkpoint's spilled nodes land on rank 0; the ring
         # balance spreads them once they flow back onto the device
@@ -2969,51 +2989,87 @@ def solve_sharded(
         # solely for ranks whose reservoir owns their alive minimum (the
         # spill inversion _Reservoir.exchange documents); otherwise the
         # spilled chunks are never touched.
-        live_min = None
-        if spilling.any():  # refill-only rounds never read the minima
-            # the packed buffer goes in whole; the bound column is sliced
-            # in-kernel (no eager [R, F] f32 materialization per round)
-            live_min = np.asarray(
-                rank_alive_min(
-                    fr.nodes, fr.count, jnp.asarray(inc_best, jnp.float32)
+        # the spill round is one collective span: per-rank participation
+        # (mode, merge verdict, rows kept, bytes moved) rides as events,
+        # so a campaign trace attributes the round to the ranks that
+        # actually paid for it (null span when tracing is off)
+        with _obs_tracing.span(
+            "bnb.spill_round",
+            ranks=num_ranks,
+            spilling=int(spilling.sum()),
+            refilling=int(refilling.sum()),
+        ) as _sp:
+            live_min = None
+            if spilling.any():  # refill-only rounds never read the minima
+                # the packed buffer goes in whole; the bound column is
+                # sliced in-kernel (no eager [R, F] f32 materialization
+                # per round)
+                live_min = np.asarray(
+                    rank_alive_min(
+                        fr.nodes, fr.count, jnp.asarray(inc_best, jnp.float32)
+                    )
                 )
-            )
-        spill_stats.rounds += 1
-        keeps = {}
-        new_counts = counts.copy()
-        for r in range(num_ranks):
-            if not (spilling[r] or refilling[r]):
-                continue
-            rv = reservoirs[r]
-            if refilling[r]:
-                keep = rv.refill_rows(inc_best, integral, capacity_per_rank)
-                if keep is not None:
+                _sp.event(
+                    "reduce.rank_alive_min",
+                    ranks=[int(x) for x in np.flatnonzero(spilling)],
+                )
+            spill_stats.rounds += 1
+            keeps = {}
+            new_counts = counts.copy()
+            for r in range(num_ranks):
+                if not (spilling[r] or refilling[r]):
+                    continue
+                rv = reservoirs[r]
+                if refilling[r]:
+                    keep = rv.refill_rows(inc_best, integral, capacity_per_rank)
+                    mode, merge = "refill", False
+                    if keep is not None:
+                        rv.stats.events += 1
+                        rank_spill_events[r] += 1
+                else:
+                    cnt = int(counts[r])
+                    live = _fetch_live_rows(fr.nodes[r], cnt)
+                    # compare ALIVE minima, exactly as the single-device
+                    # exchange does: merge the reservoir only when it
+                    # holds a strictly better open node than the rank's
+                    # live frontier
+                    merge = not (cnt and rv.min_bound() >= float(live_min[r]))
+                    mode = "exchange"
                     rv.stats.events += 1
-            else:
-                cnt = int(counts[r])
-                live = _fetch_live_rows(fr.nodes[r], cnt)
-                # compare ALIVE minima, exactly as the single-device
-                # exchange does: merge the reservoir only when it holds a
-                # strictly better open node than the rank's live frontier
-                merge = not (cnt and rv.min_bound() >= float(live_min[r]))
-                rv.stats.events += 1
-                rv.stats.full_merges += int(merge)
-                rv.stats.bytes_to_host += live.nbytes
-                keep = rv.exchange_rows(
-                    live, inc_best, integral, capacity_per_rank, merge=merge
+                    rv.stats.full_merges += int(merge)
+                    rv.stats.bytes_to_host += live.nbytes
+                    rank_spill_events[r] += 1
+                    rank_spill_to_host[r] += live.nbytes
+                    keep = rv.exchange_rows(
+                        live, inc_best, integral, capacity_per_rank, merge=merge
+                    )
+                new_counts[r] = 0 if keep is None else keep.shape[0]
+                if keep is not None:
+                    keeps[r] = keep
+                _sp.event(
+                    "rank_spill", rank=int(r), mode=mode, merge=bool(merge),
+                    kept=int(new_counts[r]), reservoir=len(rv),
                 )
-            new_counts[r] = 0 if keep is None else keep.shape[0]
-            if keep is not None:
-                keeps[r] = keep
-            _contracts.check_exchange_count(
-                int(new_counts[r]), capacity_per_rank,
-                where="solve_sharded.spill_refill",
+                _contracts.check_exchange_count(
+                    int(new_counts[r]), capacity_per_rank,
+                    where="solve_sharded.spill_refill",
+                )
+            if keeps:
+                # device-ward attribution: _apply_keeps pads every kept
+                # slice to the widest one before its single rectangular
+                # scatter — attribute the PADDED share per rank so the
+                # per-rank vector sums to the aggregate byte counter
+                row_bytes = (
+                    max(kk.shape[0] for kk in keeps.values())
+                    * int(fr.nodes.shape[-1]) * 4
+                )
+                for r in keeps:
+                    rank_spill_to_device[r] += row_bytes
+            stacked = _apply_keeps(fr, keeps, new_counts, spec, spill_stats)
+            _contracts.check_frontier(
+                stacked, n=n, where="solve_sharded.spill_refill"
             )
-        stacked = _apply_keeps(fr, keeps, new_counts, spec, spill_stats)
-        _contracts.check_frontier(
-            stacked, n=n, where="solve_sharded.spill_refill"
-        )
-        return stacked, int(new_counts.sum())
+            return stacked, int(new_counts.sum())
 
     if resume_from:
         # a checkpoint written with a smaller k (or the pre-padding
@@ -3043,6 +3099,49 @@ def solve_sharded(
     sentinel = _obs_anomaly.StallSentinel.maybe()
     if sampler is not None:
         sampler.sentinel = sentinel
+    # rank-resolved sampler (ISSUE 10): one [R, K] device stats row per
+    # sampling window (parallel.reduce.make_rank_stats — same
+    # single-readback pattern as the spill path's rank_alive_min), host
+    # columns from the per-rank accounting this loop already owns. The
+    # per-dispatch cost is due()'s counter compare; the gather amortizes
+    # over the window (TSP_BENCH=shard meters the whole hook, <= 2%)
+    rank_sampler = _obs_rank.RankSampler.maybe(num_ranks)
+    if rank_sampler is not None:
+        from ..parallel.reduce import make_rank_stats
+
+        rank_stats_row = make_rank_stats(mesh, integral=integral)
+        # pay the collective's trace+compile HERE, in setup, not inside
+        # the first sampling window — the TSP_BENCH=shard meter gates the
+        # steady-state hook cost, and a compile billed to it would be
+        # measuring XLA, not telemetry
+        rank_stats_row(
+            fr.nodes, fr.count, jnp.asarray(inc_cost0, jnp.float32)
+        )
+
+    def _rank_sample(step_now: int, inc_now: float) -> None:
+        # the whole window hook in one place so the sharded loop and the
+        # end-of-run tail flush cannot drift apart — and BOTH call sites
+        # bill the gather + readback + ring append to METER_NS (the
+        # TSP_BENCH=shard gate must price the tail flush too, not just
+        # the in-loop windows)
+        m = _obs_rank.RankSampler.METER_NS
+        if m is not None:
+            t_meter = time.perf_counter_ns()
+        row = np.asarray(
+            rank_stats_row(fr.nodes, fr.count, jnp.asarray(inc_now, jnp.float32))
+        )
+        _obs_tracing.add_event(
+            "reduce.rank_stats", step=step_now, ranks=num_ranks
+        )
+        rank_sampler.sample(
+            step_now, row[:, 0], row[:, 1], rank_nodes,
+            [len(rv) for rv in reservoirs],
+            rank_spill_events, rank_spill_to_host, rank_spill_to_device,
+            row[:, 2],
+        )
+        if m is not None:
+            m[0] += time.perf_counter_ns() - t_meter
+
     # loop-invariant certified floor for telemetry/checkpoints
     lbf = float(max(lb_floor, root_lb))
     step_ann = _obs_tracing.step_annotation_factory()
@@ -3110,7 +3209,16 @@ def solve_sharded(
             and not device_loop
             and it - last_reorder >= reorder_every
         ):
-            fr = Frontier(*reorder_ranks(tuple(fr)))
+            # one collective span per re-sort: every rank participates
+            # (the dispatch is a full-mesh shard_map), named explicitly
+            # so rank attribution survives trace aggregation
+            with _obs_tracing.span(
+                "bnb.reorder", step=it, ranks=num_ranks
+            ) as _rsp:
+                _rsp.event(
+                    "rank_participation", ranks=list(range(num_ranks))
+                )
+                fr = Frontier(*reorder_ranks(tuple(fr)))
             last_reorder = it
         if (
             checkpoint_every
@@ -3137,6 +3245,20 @@ def solve_sharded(
                 lbf,
                 sum(len(rv) for rv in reservoirs),
             )
+        if rank_sampler is not None:
+            # the rank hook: one counter compare per dispatch (billed
+            # here), one [R, K] gather + ring append per window (billed
+            # inside _rank_sample, so the end-of-run tail flush meters
+            # identically) — together the whole METER_NS figure the
+            # TSP_BENCH=shard gate prices
+            _rkm = _obs_rank.RankSampler.METER_NS
+            if _rkm is not None:
+                _t_rk = time.perf_counter_ns()
+            _rk_due = rank_sampler.due()
+            if _rkm is not None:
+                _rkm[0] += time.perf_counter_ns() - _t_rk
+            if _rk_due:
+                _rank_sample(it, best)
         if int(total0) == 0:
             break
         if time_limit_s is not None and time.perf_counter() - t0 > time_limit_s:
@@ -3162,6 +3284,22 @@ def solve_sharded(
         overflow=overflow,
     )
     _obs_metrics.fold_bnb_solve(nodes, wall, spill_stats)
+    rank_series = rank_bal = None
+    if rank_sampler is not None:
+        if rank_sampler.pending():
+            # cover the tail: the last window's deltas must reach the
+            # series even when the loop exits between sample cadences
+            _rank_sample(it, last_inc)
+        rank_series = rank_sampler.series()
+        rank_bal = _obs_rank.rank_balance(
+            rank_series, rank_nodes,
+            spill_events=rank_spill_events,
+            spill_bytes_to_host=rank_spill_to_host,
+            spill_bytes_to_device=rank_spill_to_device,
+            reservoir=[len(rv) for rv in reservoirs],
+            events=rank_sampler.watch.events,
+        )
+        _obs_rank.fold_rank_view(rank_bal)
     return BnBResult(
         cost=float(ic[0]),
         tour=np.asarray(itour)[0],
@@ -3185,7 +3323,12 @@ def solve_sharded(
         spill_bytes_to_host=spill_stats.bytes_to_host,
         spill_bytes_to_device=spill_stats.bytes_to_device,
         series=sampler.series() if sampler is not None else None,
-        anomalies=sentinel.summary() if sentinel is not None else None,
+        # stall + rank-starvation verdicts on one step-ordered timeline
+        anomalies=_obs_anomaly.merge_summaries(
+            sentinel, rank_sampler.watch if rank_sampler is not None else None
+        ),
+        rank_series=rank_series,
+        rank_balance=rank_bal,
     )
 
 

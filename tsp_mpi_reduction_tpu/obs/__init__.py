@@ -36,7 +36,14 @@ The performance-accounting layer (ISSUE 9) consumes those pillars:
   attainment + error-budget burn rate from the tier-labeled latency
   histograms (the stats ``slo`` block).
 - :mod:`.anomaly` — the ``StepSampler``-fed stall sentinel (nodes/sec
-  collapse, certified-LB stagnation) firing health events mid-solve.
+  collapse, certified-LB stagnation) firing health events mid-solve,
+  plus the per-rank ``rank_starvation`` sentinel (ISSUE 10).
+- :mod:`.rankview` — rank-resolved telemetry for the sharded search
+  (ISSUE 10): a per-window ``RankSampler`` ring fed by one small
+  ``[R, K]`` device stats row (``parallel.reduce.make_rank_stats``),
+  imbalance accounting (occupancy CV, straggler score, starved ranks)
+  stamped as ``rank_series`` + ``obs.rank_balance`` into the driver
+  payload, and bounded rank-labeled registry gauges.
 - :mod:`.tracing` additionally propagates across PROCESSES via the
   ``TSP_TRACE_PARENT=<trace_id>:<span_id>`` env contract, so a chunked
   campaign reconstructs as one span tree.

@@ -279,6 +279,21 @@ DEFAULT_RULES: Dict[str, MetricRule] = {
     "atomic_checkpoint_overhead": MetricRule(
         direction="lower", rel_threshold=0.0, abs_threshold=5.0, min_samples=4
     ),
+    # rank-resolved telemetry hook (ISSUE 10, TSP_BENCH=shard): same
+    # absolute-band rationale as obs_overhead — a percentage near zero
+    # has no meaningful relative band; 0.4% -> 1.2% drift passes, a
+    # creep past ~3% (hook grew past the <=2% design budget + noise)
+    # fails the build
+    "shard_rank_obs_overhead": MetricRule(
+        direction="lower", rel_threshold=0.0, abs_threshold=2.5, min_samples=4
+    ),
+    # marginal rank-hook cost per host dispatch in us (the due() compare
+    # amortizing one [R, K] collective per window): absolute band wide
+    # enough for dispatch-size noise, tight enough that an accidental
+    # per-dispatch collective (window=1 regression) jumps the series
+    "shard_rank_us_per_dispatch": MetricRule(
+        direction="lower", rel_threshold=0.0, abs_threshold=8.0, min_samples=4
+    ),
 }
 
 

@@ -10,6 +10,13 @@ multi-hour proof campaign costs bounded memory.
 
 The series flushes into ``BnBResult.series`` → ``bnb_solve.py`` /
 ``bnb_chunked.py`` JSON, and ``tools/obs_report.py`` renders it.
+
+This series is mesh-GLOBAL (one row per dispatch, aggregates folded
+across ranks). Its rank-resolved sibling — per-rank occupancy, nodes,
+spill and best-bound vectors, one row per sampling window — lives in
+``obs.rankview.RankSampler`` and flushes as ``BnBResult.rank_series``
+(ISSUE 10); the two share the driver payload and the report tool
+(``--series`` / ``--ranks``).
 """
 
 from __future__ import annotations

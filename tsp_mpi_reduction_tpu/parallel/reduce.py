@@ -284,6 +284,68 @@ def make_rank_alive_min(mesh: jax.sharding.Mesh, integral: bool = False):
     )
 
 
+#: column order of the [R, K] row ``make_rank_stats`` returns — kept next
+#: to the builder so the rankview consumer (obs.rankview.RankSampler) and
+#: any future column rider agree on indices by name, not by magic number
+RANK_STAT_COLUMNS = ("count", "alive", "best_bound")
+
+
+def make_rank_stats(mesh: jax.sharding.Mesh, integral: bool = False):
+    """Build the per-rank frontier stats collective for ``mesh``.
+
+    The rank-resolved telemetry layer (obs.rankview) needs, once per
+    sampling window, a small per-rank view of the sharded search: how
+    many rows each rank holds (``count``), how many of those the
+    incumbent has not yet closed (``alive``), and each rank's best open
+    bound (``best_bound`` — +inf when the rank is drained). Same
+    single-readback pattern as :func:`make_rank_alive_min`: everything
+    is computed shard-locally on device over the resident packed buffer
+    (bound column sliced + bitcast in-kernel, no eager [R, F] f32
+    materialization) and the host reads back ONE [R, K] f32 row — tens
+    of bytes, never the buffer.
+
+    Returns a jitted callable ``(nodes [R, F, cols] i32 packed rows,
+    counts [R] i32, inc scalar f32) -> [R, K] f32`` with K =
+    ``len(RANK_STAT_COLUMNS)``. The buffer is NOT donated (the host
+    loop keeps expanding it). ``integral`` selects the fixed-point
+    alive predicate, matching the engine's ceil-aware pruning.
+    """
+
+    def body(nodes, counts, inc):
+        rows = nodes[0]  # [F, cols] packed int32 rows
+        # bound lives at column cols-2 (see make_rank_alive_min)
+        b = jax.lax.bitcast_convert_type(rows[:, -2], jnp.float32)
+        pos = jnp.arange(rows.shape[0], dtype=jnp.int32)
+        live = pos < counts[0]
+        if integral:
+            alive = live & (b <= inc - 1.0)
+        else:
+            alive = live & (b < inc)
+        return jnp.stack(
+            [
+                counts[0].astype(jnp.float32),
+                jnp.sum(alive.astype(jnp.int32)).astype(jnp.float32),
+                jnp.min(jnp.where(alive, b, jnp.inf)),
+            ]
+        )[None]
+
+    # counted at build time on the host, never in the traced body (R8):
+    # one build per (mesh, integral) config per solve is the expectation;
+    # a growing series is recompile evidence
+    _REGISTRY.inc(
+        "collectives_built_total", kind="rank_stats",
+        ranks=mesh.devices.size, integral=integral,
+    )
+    return jax.jit(
+        shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(RANK_AXIS), P(RANK_AXIS), P()),
+            out_specs=P(RANK_AXIS),
+        )
+    )
+
+
 def compat_capacity(num_blocks: int, n: int, num_ranks: int) -> int:
     """Buffer size needed by the ``compat_bugs`` reduce (host simulation).
 
