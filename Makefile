@@ -14,7 +14,7 @@ PY ?= python
 CXX ?= g++
 CXXFLAGS ?= -O2 -std=c++11
 
-.PHONY: all lint chaos native oracle test test-fast bench bench-serve bench-faults bench-compile bench-obs bench-step bench-shard bench-check run sweep goldens clean
+.PHONY: all lint chaos native oracle test test-fast bench bench-serve bench-faults bench-compile bench-obs bench-step bench-shard bench-fleet bench-check run sweep goldens clean
 
 all: lint native oracle chaos bench-check
 
@@ -104,6 +104,16 @@ bench-obs:
 # -> BENCH_SHARD_OBS.json
 bench-shard:
 	TSP_BENCH=shard $(PY) bench.py
+
+# fleet serving bench (ISSUE 11): sustained RPS + p99 vs replica count
+# 1/2/4 (clean, then under injected replica.kill), plus the chaos
+# acceptance demo — 3 replicas x 48 mixed-deadline requests through
+# kills AND hangs: 100% answered exactly once with valid tours,
+# cross-replica shared-cache hits, restarts/redispatches in health,
+# stitched traces with zero orphans -> BENCH_FLEET.json. The governed
+# history metric is the answered-exactly-once rate (counter estimator).
+bench-fleet:
+	TSP_BENCH=fleet $(PY) bench.py
 
 # regression sentinel over bench_history.jsonl (ISSUE 9): every TSP_BENCH
 # run appends a fingerprinted record; this gate fails when a governed

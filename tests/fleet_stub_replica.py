@@ -1,0 +1,97 @@
+"""Stub fleet replica: the serve JSONL contract without the jax import.
+
+The fleet supervisor/front only need a process that speaks the line
+protocol — spawning the real ``SolveService`` costs a jax import per
+process, which would dominate the fast unit tests. This stub answers
+every request with a host nearest-neighbor tour (pure stdlib, spawns in
+~50 ms) and exposes failure knobs through its env:
+
+- ``STUB_SLEEP_MS``       per-request sleep before answering
+- ``STUB_DIE_AFTER``      exit(1) after answering N requests (a crash
+                          mid-stream, for restart/re-dispatch tests)
+- ``STUB_IGNORE_AFTER``   stop answering (but keep reading) after N
+                          responses — a wedge without signals
+
+Per-request ``_stub_sleep_ms`` overrides ``STUB_SLEEP_MS`` for that one
+request (lets a test wedge exactly one request). Responses mirror the
+serve schema fields the front relies on (id/n/cost/tour/tier/cache).
+"""
+
+import json
+import math
+import os
+import sys
+import time
+
+
+def nn_tour(xy):
+    n = len(xy)
+    if n == 1:
+        return 0.0, [0, 0]
+
+    def d(a, b):
+        dx, dy = xy[a][0] - xy[b][0], xy[a][1] - xy[b][1]
+        return math.sqrt(dx * dx + dy * dy)
+
+    visited = [False] * n
+    visited[0] = True
+    tour = [0]
+    cost = 0.0
+    cur = 0
+    for _ in range(n - 1):
+        best, best_d = -1, float("inf")
+        for j in range(n):
+            if not visited[j] and d(cur, j) < best_d:
+                best, best_d = j, d(cur, j)
+        cost += best_d
+        tour.append(best)
+        visited[best] = True
+        cur = best
+    cost += d(cur, 0)
+    tour.append(0)
+    return cost, tour
+
+
+def main() -> int:
+    sleep_ms = float(os.environ.get("STUB_SLEEP_MS", "0"))
+    die_after = int(os.environ.get("STUB_DIE_AFTER", "0"))
+    ignore_after = int(os.environ.get("STUB_IGNORE_AFTER", "0"))
+    answered = 0
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            req = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if ignore_after and answered >= ignore_after:
+            continue  # the wedge: keep reading, never answer
+        t0 = time.monotonic()
+        pause = float(req.get("_stub_sleep_ms", sleep_ms))
+        if pause:
+            time.sleep(pause / 1000.0)
+        try:
+            cost, tour = nn_tour(req["xy"])
+            resp = {
+                "id": req.get("id"),
+                "n": len(req["xy"]),
+                "cost": cost,
+                "tour": tour,
+                "tier": "greedy",
+                "certified_gap": None,
+                "cache": "miss",
+                "latency_ms": round((time.monotonic() - t0) * 1000.0, 3),
+            }
+        except (KeyError, TypeError, IndexError) as e:
+            resp = {"id": req.get("id"), "error": str(e)}
+        sys.stdout.write(json.dumps(resp) + "\n")
+        sys.stdout.flush()
+        answered += 1
+        if die_after and answered >= die_after:
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

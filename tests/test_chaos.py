@@ -227,9 +227,14 @@ def test_serve_loop_clean_run_has_quiet_health():
 
 def test_every_registered_seam_is_exercised():
     """A seam without a chaos test is untested recovery machinery: the
-    union of seams covered above must BE the registry's seam set."""
+    union of seams covered above — plus the fleet suite's (imported, so
+    a renamed or deleted fleet chaos test breaks THIS guard, not just
+    its own file) — must BE the registry's seam set."""
+    from test_fleet_chaos import FLEET_CHAOS_SEAMS
+
     covered = {seam for _, seam in SOLVER_SPECS}
     covered |= {spec.split(":", 1)[0] for spec, _ in SERVE_SPECS}
+    covered |= set(FLEET_CHAOS_SEAMS)
     assert covered == set(faults.SEAMS), (
         f"uncovered seams: {set(faults.SEAMS) - covered}"
     )

@@ -1,0 +1,484 @@
+"""Fleet unit suite (ISSUE 11): shared cache tier + supervisor/front.
+
+The shared-tier tests run in-process (two cache instances over one
+directory ARE two replicas as far as the disk tier is concerned). The
+supervisor/front tests use the stub replica (``fleet_stub_replica.py``)
+— the real-serve-subprocess paths are covered by ``test_fleet_chaos.py``
+so these stay fast enough for tier-1.
+"""
+
+import io
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tsp_mpi_reduction_tpu.fleet import (
+    FleetConfig,
+    FleetFront,
+    ReplicaSpec,
+    SharedCacheTier,
+    TieredSolutionCache,
+)
+from tsp_mpi_reduction_tpu.fleet.supervisor import SupervisorConfig
+from tsp_mpi_reduction_tpu.resilience.health import HEALTH
+from tsp_mpi_reduction_tpu.serve.cache import CacheEntry
+from tsp_mpi_reduction_tpu.serve.service import run_jsonl
+
+pytestmark = [
+    pytest.mark.fleet,
+    pytest.mark.filterwarnings(
+        "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+    ),
+]
+
+STUB = os.path.join(os.path.dirname(__file__), "fleet_stub_replica.py")
+
+
+def _entry(cost, tier="greedy", gap=None, n=6):
+    tour = np.concatenate([np.arange(n, dtype=np.int32), [0]])
+    return CacheEntry(cost=float(cost), tour=tour, certified_gap=gap, tier=tier)
+
+
+def _stub_specs(count, env_extra=None, **spec_kw):
+    env = dict(os.environ)
+    env.update(env_extra or {})
+    return [
+        ReplicaSpec(argv=[sys.executable, STUB], env=env, scrape=False, **spec_kw)
+        for _ in range(count)
+    ]
+
+
+def _fast_cfg(tmp_path, specs, **kw):
+    sup = kw.pop("supervisor", None) or SupervisorConfig(
+        probe_interval_s=0.05,
+        wedge_timeout_s=1.0,
+        startup_grace_s=0.5,
+        restart_backoff_base_s=0.05,
+        restart_backoff_max_s=0.3,
+        healthy_reset_s=2.0,
+    )
+    return FleetConfig(
+        threads=kw.pop("threads", 4),
+        shared_cache_dir=str(tmp_path / "shared"),
+        compile_cache_dir=str(tmp_path / "cc"),
+        replica_specs=specs,
+        hop_timeout_s=kw.pop("hop_timeout_s", 5.0),
+        supervisor=sup,
+        **kw,
+    )
+
+
+def _requests(count, n=6, seed=0, deadline_ms=5000.0):
+    rng = np.random.default_rng(seed)
+    return [
+        {"id": f"r{i}", "xy": rng.uniform(0, 100, (n, 2)).tolist(),
+         "deadline_ms": deadline_ms}
+        for i in range(count)
+    ]
+
+
+def _run(front, requests):
+    out = io.StringIO()
+    run_jsonl([json.dumps(r) + "\n" for r in requests], out, service=front)
+    return [json.loads(ln) for ln in out.getvalue().strip().splitlines()]
+
+
+def _assert_valid(resp, n):
+    assert "error" not in resp, resp
+    tour = resp["tour"]
+    assert tour[0] == tour[-1] and sorted(tour[:-1]) == list(range(n))
+
+
+# -- shared disk cache tier ----------------------------------------------------
+
+
+def test_shared_tier_cross_instance_roundtrip(tmp_path):
+    """Two tier instances over one directory = two replicas: an entry
+    published by one is a (promoted) hit in the other, fields intact."""
+    a = TieredSolutionCache(8, str(tmp_path))
+    b = TieredSolutionCache(8, str(tmp_path))
+    entry = _entry(42.0, tier="bnb", gap=0.0)
+    a.put("k1", entry)
+    got = b.get("k1")
+    assert got is not None
+    assert got.cost == 42.0 and got.tier == "bnb" and got.certified_gap == 0.0
+    assert np.array_equal(got.tour, entry.tour)
+    # the promotion filled b's L1: a second get is a pure L1 hit
+    assert b.get("k1") is not None
+    assert b.shared.stats()["hits"] == 1
+
+
+def test_shared_tier_better_entry_arbitration(tmp_path):
+    """PR 3's replacement policy across processes: a certified optimum
+    survives later weaker publishes; a strictly cheaper tour wins."""
+    tier = SharedCacheTier(str(tmp_path))
+    tier.put("k", _entry(10.0, tier="bnb", gap=0.0))
+    tier.put("k", _entry(10.0, tier="greedy"))   # worse: no certificate
+    assert tier.get("k").tier == "bnb"
+    tier.put("k", _entry(8.0, tier="greedy"))    # cheaper: wins anyway
+    assert tier.get("k").cost == 8.0
+    stats = tier.stats()
+    assert stats["publishes"] == 2 and stats["kept_better"] == 1
+
+
+def test_shared_tier_concurrent_publishers_always_valid(tmp_path):
+    """N threads racing the same canonical key: every read during and
+    after the race parses (atomic publish — no torn images), and the
+    final entry is one of the published ones with the best cost."""
+    tier = SharedCacheTier(str(tmp_path))
+    costs = [50.0 - i for i in range(10)]
+    barrier = threading.Barrier(10)
+
+    def publish(c):
+        barrier.wait()
+        SharedCacheTier(str(tmp_path)).put("k", _entry(c))
+
+    threads = [threading.Thread(target=publish, args=(c,)) for c in costs]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    final = tier.get("k")
+    assert final is not None and final.cost in costs
+    # better-entry arbitration converges on a re-publish of the best
+    tier.put("k", _entry(min(costs)))
+    assert tier.get("k").cost == min(costs)
+    assert tier.stats()["corrupt_skipped"] == 0
+
+
+@pytest.mark.parametrize("mangle", ["truncate", "corrupt", "garbage"])
+def test_shared_tier_torn_entry_reads_as_miss(tmp_path, mangle):
+    """A torn/bit-rotted/garbage entry file is a MISS (counted), never a
+    wrong tour or an exception — the read_with_fallback posture."""
+    tier = SharedCacheTier(str(tmp_path))
+    tier.put("k", _entry(9.0))
+    path = tier._path("k")
+    blob = open(path, "rb").read()
+    if mangle == "truncate":
+        open(path, "wb").write(blob[: len(blob) // 2])
+    elif mangle == "corrupt":
+        mutated = bytearray(blob)
+        mutated[len(mutated) // 2] ^= 0xFF
+        open(path, "wb").write(bytes(mutated))
+    else:
+        open(path, "wb").write(b"not a checkpoint at all")
+    assert tier.get("k") is None
+    assert tier.stats()["corrupt_skipped"] == 1
+    # a fresh publish heals the entry
+    tier.put("k", _entry(7.0))
+    assert tier.get("k").cost == 7.0
+
+
+def test_certified_entry_survives_degraded_resubmit_across_replicas(tmp_path):
+    """ISSUE satellite: replica A certifies an instance; replica B gets a
+    deadline-degraded resubmission of it (permuted + translated) and
+    must answer from the shared tier with the certificate intact — and
+    B's own later greedy publish must not clobber the certified entry."""
+    from tsp_mpi_reduction_tpu.serve.ladder import LadderConfig
+    from tsp_mpi_reduction_tpu.serve.service import ServiceConfig, SolveService
+
+    rng = np.random.default_rng(7)
+    xy = rng.uniform(0, 100, (8, 2))
+    mk = lambda: ServiceConfig(  # noqa: E731
+        shared_cache_dir=str(tmp_path), threads=2,
+        ladder=LadderConfig(bnb_max_n=0),
+    )
+    with SolveService(mk()) as a:
+        r1 = a.handle({"id": "a", "xy": xy.tolist(), "deadline_ms": 60_000.0})
+    assert r1["certified_gap"] == 0.0 and r1["tier"] == "pipeline"
+    resub = xy[rng.permutation(8)] + 123.0
+    with SolveService(mk()) as b:
+        r2 = b.handle({"id": "b", "xy": resub.tolist(), "deadline_ms": 0.5})
+        stats = json.loads(b.stats_json())
+    assert r2["cache"] == "hit" and r2["tier"] == "pipeline"
+    assert r2["certified_gap"] == 0.0
+    assert abs(r2["cost"] - r1["cost"]) < 1e-6
+    assert stats["cache"]["shared"]["hits"] == 1
+
+
+def test_shared_tier_survives_l1_eviction(tmp_path):
+    """The disk tier outlives the L1: an entry evicted from a tiny L1 is
+    still served (and re-promoted) from disk."""
+    tier = TieredSolutionCache(1, str(tmp_path))
+    tier.put("k1", _entry(1.0))
+    tier.put("k2", _entry(2.0))  # evicts k1 from the 1-slot L1
+    assert tier.get("k1") is not None  # disk hit
+    assert tier.shared.stats()["hits"] >= 1
+
+
+# -- supervisor + front over stub replicas -------------------------------------
+
+
+def test_fleet_basic_workload_exactly_once(tmp_path):
+    front = FleetFront(_fast_cfg(tmp_path, _stub_specs(2)))
+    try:
+        reqs = _requests(12)
+        responses = _run(front, reqs)
+        stats = json.loads(front.stats_json())
+    finally:
+        front.close()
+    assert [r["id"] for r in responses] == [r["id"] for r in reqs]  # order kept
+    for r in responses:
+        _assert_valid(r, 6)
+        assert "fleet_latency_ms" in r
+    assert stats["responses"] == 12 and stats["fleet"]["alive"] == 2
+
+
+def test_replica_death_restart_and_redispatch(tmp_path):
+    """A replica crashing mid-stream: its in-flight requests re-dispatch
+    to the survivor (exactly-once, all valid), the supervisor restarts
+    it with bounded backoff, and both actions land in health + stats."""
+    # the dying replica is FAST (it answers, attracts the next dispatch
+    # into its stdin, then exits with it in flight — a deterministic
+    # mid-flight death); the survivor is slow enough to stay busy
+    specs = _stub_specs(1, env_extra={"STUB_DIE_AFTER": "2", "STUB_SLEEP_MS": "20"})
+    specs += _stub_specs(1, env_extra={"STUB_SLEEP_MS": "150"})
+    front = FleetFront(_fast_cfg(tmp_path, specs, threads=3))
+    h0 = HEALTH.snapshot()
+    try:
+        responses = _run(front, _requests(16))
+        # the dying replica restarts on the supervisor's cadence, not the
+        # workload's: poll briefly for the respawn
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if sum(r.restarts for r in front.supervisor.replicas) >= 1:
+                break
+            time.sleep(0.05)
+        stats = json.loads(front.stats_json())
+    finally:
+        front.close()
+    ids = [r["id"] for r in responses]
+    assert len(ids) == len(set(ids)) == 16
+    for r in responses:
+        _assert_valid(r, 6)
+    h = HEALTH.delta_since(h0)
+    assert stats["fleet"]["restarts_total"] >= 1
+    assert h["fleet_replica_restarts"] >= 1
+    # in-flight work moved off the corpse (die-after-2 with 60ms holds
+    # guarantees at least one request was in flight at death)
+    assert h["fleet_redispatches"] >= 1
+    assert stats["fleet"]["redispatches_total"] == h["fleet_redispatches"]
+
+
+def test_first_writer_wins_suppresses_duplicate(tmp_path):
+    """A hop that times out (slow replica) re-dispatches; the slow
+    replica's late answer is suppressed — exactly one response."""
+    slow = _stub_specs(1, env_extra={"STUB_SLEEP_MS": "1200"})
+    fast = _stub_specs(1)
+    front = FleetFront(
+        _fast_cfg(
+            tmp_path, slow + fast, threads=1, hop_timeout_s=0.3,
+            # wedge detection OFF the table: the slow replica must stay
+            # alive long enough to deliver its late (suppressed) answer
+            supervisor=SupervisorConfig(
+                probe_interval_s=0.05, wedge_timeout_s=30.0,
+                restart_backoff_base_s=0.05, restart_backoff_max_s=0.2,
+            ),
+        )
+    )
+    try:
+        responses = _run(front, _requests(2, deadline_ms=8000.0))
+        # wait for the slow replica's late answers to surface
+        deadline = time.monotonic() + 4.0
+        while time.monotonic() < deadline:
+            stats = json.loads(front.stats_json())
+            if stats["fleet"]["duplicates_suppressed"] >= 1:
+                break
+            time.sleep(0.05)
+    finally:
+        front.close()
+    assert len(responses) == 2
+    for r in responses:
+        _assert_valid(r, 6)
+    assert stats["fleet"]["duplicates_suppressed"] >= 1
+    assert stats["fleet"]["redispatches_total"] >= 1
+
+
+def test_degraded_no_replicas_answers_greedy(tmp_path):
+    """Zero replicas: every request still gets a valid tour, locally,
+    with the reason counted — the front never queues unboundedly."""
+    front = FleetFront(_fast_cfg(tmp_path, []))
+    h0 = HEALTH.snapshot()
+    try:
+        responses = _run(front, _requests(4))
+        stats = json.loads(front.stats_json())
+    finally:
+        front.close()
+    for r in responses:
+        _assert_valid(r, 6)
+        assert r["degraded"] == "no_replicas" and r["tier"] == "greedy"
+    assert stats["fleet"]["degraded_answers"]["no_replicas"] == 4
+    assert HEALTH.delta_since(h0)["fleet_degraded_answers"] == 4
+
+
+def test_degraded_answers_from_shared_cache(tmp_path):
+    """A degraded front serves CERTIFIED cross-replica work from the
+    shared tier instead of falling back to greedy."""
+    import tsp_mpi_reduction_tpu.serve.canonical as canon
+
+    rng = np.random.default_rng(3)
+    xy = rng.uniform(0, 100, (6, 2))
+    ci = canon.canonicalize(xy)
+    seed_tier = TieredSolutionCache(4, str(tmp_path / "shared"))
+    tour = np.concatenate([np.arange(6, dtype=np.int32), [0]])
+    seed_tier.put(
+        ci.key,
+        CacheEntry(
+            cost=canon.tour_length_np(canon.from_canonical_tour(tour, ci), xy),
+            tour=tour, certified_gap=0.0, tier="bnb",
+        ),
+    )
+    front = FleetFront(_fast_cfg(tmp_path, []))
+    try:
+        responses = _run(
+            front, [{"id": "c", "xy": xy.tolist(), "deadline_ms": 500.0}]
+        )
+    finally:
+        front.close()
+    (resp,) = responses
+    _assert_valid(resp, 6)
+    assert resp["cache"] == "hit" and resp["tier"] == "bnb"
+    assert resp["certified_gap"] == 0.0 and resp["degraded"] == "no_replicas"
+
+
+@pytest.mark.chaos
+def test_dispatch_retry_capped_by_deadline(tmp_path):
+    """front.dispatch raising on EVERY crossing: the bounded retry burns
+    attempts (counted as retries), never exceeds the request deadline by
+    more than slack, and the request still gets a local answer. (Chaos
+    marker: this is the ``front.dispatch`` seam's coverage in the
+    every-seam-is-exercised guard — the seam fires in the front, so stub
+    replicas exercise it exactly as real ones would.)"""
+    from tsp_mpi_reduction_tpu.resilience import faults
+
+    front = FleetFront(_fast_cfg(tmp_path, _stub_specs(1)))
+    h0 = HEALTH.snapshot()
+    faults.configure("front.dispatch:raise,count=0")
+    try:
+        t0 = time.monotonic()
+        responses = _run(front, _requests(2, deadline_ms=400.0))
+        wall = time.monotonic() - t0
+    finally:
+        faults.clear()
+        front.close()
+    for r in responses:
+        _assert_valid(r, 6)
+        assert r["degraded"] in ("dispatch", "deadline")
+    h = HEALTH.delta_since(h0)
+    assert h["retries"] >= 1  # absorbed front.dispatch faults
+    assert h["faults_injected"].get("front.dispatch", 0) >= 2
+    assert wall < 5.0  # the 400 ms budgets cannot compound into seconds
+
+
+def test_wedged_stub_detected_and_redispatched(tmp_path):
+    """A replica that silently stops answering (no signals — the stub
+    just ignores requests) is wedge-detected by the response-flow rule,
+    killed, restarted; its requests land elsewhere exactly once."""
+    wedge = _stub_specs(1, env_extra={"STUB_IGNORE_AFTER": "1"})
+    healthy = _stub_specs(1)
+    front = FleetFront(
+        _fast_cfg(
+            tmp_path, wedge + healthy, threads=2, hop_timeout_s=0.6,
+            supervisor=SupervisorConfig(
+                probe_interval_s=0.05, wedge_timeout_s=0.4,
+                startup_grace_s=0.2, restart_backoff_base_s=0.05,
+                restart_backoff_max_s=0.2, healthy_reset_s=2.0,
+            ),
+        )
+    )
+    h0 = HEALTH.snapshot()
+    try:
+        responses = _run(front, _requests(8, deadline_ms=6000.0))
+        # the respawn lands on the supervisor's backoff cadence, not the
+        # workload's: poll briefly before reading the stats
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if sum(r.restarts for r in front.supervisor.replicas) >= 1:
+                break
+            time.sleep(0.05)
+        stats = json.loads(front.stats_json())
+    finally:
+        front.close()
+    ids = [r["id"] for r in responses]
+    assert len(ids) == len(set(ids)) == 8
+    for r in responses:
+        _assert_valid(r, 6)
+    h = HEALTH.delta_since(h0)
+    assert h["stuck_restarts"] >= 1  # the wedge verdict
+    assert h["fleet_redispatches"] >= 1
+    assert stats["fleet"]["restarts_total"] >= 1
+
+
+def test_restart_backoff_is_bounded(tmp_path):
+    """A crash-looping replica's respawn delays follow the bounded
+    exponential curve — the scheduled delay never exceeds the cap."""
+    from tsp_mpi_reduction_tpu.fleet.replica import Replica
+
+    spec = _stub_specs(1, env_extra={"STUB_DIE_AFTER": "1"})[0]
+    rep = Replica(0, spec, on_response=lambda *a: None)
+    cap = 0.25
+    from tsp_mpi_reduction_tpu.resilience.retry import RetryPolicy
+
+    policy = RetryPolicy(max_attempts=100, base_delay_s=0.05, max_delay_s=cap, seed=0)
+    import random as _random
+
+    delays = []
+    for attempt in range(1, 12):
+        rep.restart_due_at = None  # fresh death
+        t0 = time.monotonic()
+        rep.schedule_restart(
+            lambda k: policy.delay_s(k, _random.Random(k))
+        )
+        delays.append(rep.restart_due_at - t0)
+    assert all(d <= cap + 0.01 for d in delays)
+    assert delays[0] <= 0.06  # first retry is fast
+    # the curve actually grew toward the cap before flattening
+    assert max(delays) > delays[0]
+
+
+def test_front_stats_fleet_block_and_obs_report(tmp_path, capsys):
+    """The stats line carries the fleet block; obs_report --fleet renders
+    it and exits 2 on a payload without one."""
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+    import obs_report
+
+    front = FleetFront(_fast_cfg(tmp_path, _stub_specs(1)))
+    try:
+        _run(front, _requests(3))
+        stats_line = front.stats_json()
+    finally:
+        front.close()
+    stats = json.loads(stats_line)
+    assert set(stats["fleet"]) >= {
+        "replicas", "replica_count", "alive", "restarts_total",
+        "redispatches_total", "degraded_answers", "duplicates_suppressed",
+        "shared_cache",
+    }
+    good = tmp_path / "fleet_stats.json"
+    good.write_text(stats_line + "\n")
+    assert obs_report.main(["--fleet", str(good)]) == 0
+    out = capsys.readouterr().out
+    assert "replica 0" in out and "supervision:" in out
+    # a plain serve stats payload (no fleet block) is exit 2
+    bad = tmp_path / "serve_stats.json"
+    bad.write_text(json.dumps({"responses": 1, "cache": {}}) + "\n")
+    assert obs_report.main(["--fleet", str(bad)]) == 2
+
+
+def test_fleet_stats_slo_block_judges_front_latency(tmp_path):
+    """The front's fleet-level SLO verdicts come from its OWN end-to-end
+    histograms (fleet_request_seconds), session-windowed."""
+    front = FleetFront(_fast_cfg(tmp_path, _stub_specs(1)))
+    try:
+        _run(front, _requests(5, deadline_ms=5000.0))
+        stats = json.loads(front.stats_json())
+    finally:
+        front.close()
+    greedy = stats["slo"]["greedy"]
+    assert greedy["requests"] == 5
+    assert greedy["attainment"] is not None

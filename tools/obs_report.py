@@ -242,6 +242,92 @@ def render_ranks(path: str) -> str:
     return "\n".join(out)
 
 
+def render_fleet(path: str) -> str:
+    """Render a fleet front's stats line (ISSUE 11): per-replica state +
+    last scrape totals, supervision totals (restarts / re-dispatches /
+    degraded answers / suppressed duplicates), the shared disk cache
+    tier, and fleet-level SLO attainment.
+
+    A payload WITHOUT a ``fleet`` block is an error (exit 2), not an
+    empty section — the caller explicitly asked for fleet attribution,
+    and a plain serve stats line carries none (same posture as the
+    missing ``--trace`` sink and the rank-less ``--ranks``)."""
+    out: List[str] = []
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                doc = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            fleet = doc.get("fleet") if isinstance(doc, dict) else None
+            if not fleet:
+                continue
+            out.append(
+                f"== fleet {path}: {fleet.get('replica_count', '?')} replicas "
+                f"({fleet.get('alive', '?')} alive), "
+                f"{doc.get('responses', 0)} responses, "
+                f"{doc.get('errors', 0)} errors =="
+            )
+            out.append(
+                f"  supervision: restarts {fleet.get('restarts_total', 0)}  "
+                f"redispatches {fleet.get('redispatches_total', 0)}  "
+                f"duplicates suppressed {fleet.get('duplicates_suppressed', 0)}"
+            )
+            degraded = fleet.get("degraded_answers", {})
+            out.append(
+                "  degraded answers: "
+                + (
+                    "  ".join(f"{k} {v}" for k, v in sorted(degraded.items()))
+                    or "none"
+                )
+            )
+            for row in fleet.get("replicas", []):
+                scrape = row.get("scrape") or {}
+                scrape_txt = (
+                    "  ".join(f"{k} {v}" for k, v in sorted(scrape.items()))
+                    if scrape
+                    else "(no scrape)"
+                )
+                out.append(
+                    f"  replica {row.get('index')}: pid {row.get('pid')}  "
+                    f"{'alive' if row.get('alive') else 'DOWN'}  "
+                    f"gen {row.get('generation')}  "
+                    f"restarts {row.get('restarts')}  "
+                    f"dispatched {row.get('dispatched')}  "
+                    f"answered {row.get('answered')}  "
+                    f"scrape: {scrape_txt}"
+                )
+            shared = fleet.get("shared_cache")
+            if shared:
+                out.append(
+                    "  shared cache: "
+                    + "  ".join(f"{k} {v}" for k, v in sorted(shared.items()))
+                )
+            slo = doc.get("slo") or {}
+            for tier in sorted(slo):
+                row = slo[tier]
+                if not isinstance(row, dict) or row.get("attainment") is None:
+                    continue
+                verdict = "ok" if row.get("ok") else "MISSED"
+                out.append(
+                    f"  slo {tier}: attainment {row['attainment']:.4f} "
+                    f"(goal {row.get('goal')}, target "
+                    f"{row.get('target_ms')} ms)  burn "
+                    f"{row.get('burn_rate')}  {verdict}"
+                )
+    if not out:
+        raise ValueError(
+            f"no fleet block in {path!r} — this renderer reads the fleet "
+            "front's stats JSON (python -m tsp_mpi_reduction_tpu fleet "
+            "--stats); a plain serve stats line carries no per-replica "
+            "attribution"
+        )
+    return "\n".join(out)
+
+
 def render_metrics(path: str, top: int = 20) -> str:
     with open(path, encoding="utf-8") as fh:
         data = json.load(fh)
@@ -276,13 +362,19 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "block (sharded runs) — per-rank totals, imbalance "
                     "verdict, occupancy heatmap; errors (exit 2) when the "
                     "payload carries no per-rank telemetry")
+    ap.add_argument("--fleet", default=None,
+                    help="fleet front stats JSON (line file ok) — "
+                    "per-replica scrape totals, supervision counters, "
+                    "shared-cache tier, fleet SLO attainment; errors "
+                    "(exit 2) when the payload has no fleet block")
     ap.add_argument("--metrics", default=None, help="/metrics.json dump")
     ap.add_argument("--limit", type=int, default=None,
                     help="max traces to render")
     args = ap.parse_args(argv)
-    if not (args.trace or args.series or args.ranks or args.metrics):
+    if not (args.trace or args.series or args.ranks or args.fleet or args.metrics):
         ap.error(
-            "give at least one of --trace / --series / --ranks / --metrics"
+            "give at least one of --trace / --series / --ranks / --fleet "
+            "/ --metrics"
         )
     sections = []
     try:
@@ -292,6 +384,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             sections.append(render_series(args.series))
         if args.ranks:
             sections.append(render_ranks(args.ranks))
+        if args.fleet:
+            sections.append(render_fleet(args.fleet))
         if args.metrics:
             sections.append(render_metrics(args.metrics))
     except (OSError, ValueError) as e:

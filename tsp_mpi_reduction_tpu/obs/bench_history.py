@@ -294,6 +294,16 @@ DEFAULT_RULES: Dict[str, MetricRule] = {
     "shard_rank_us_per_dispatch": MetricRule(
         direction="lower", rel_threshold=0.0, abs_threshold=8.0, min_samples=4
     ),
+    # fleet robustness (ISSUE 11, TSP_BENCH=fleet): fraction of the chaos
+    # leg's requests answered EXACTLY ONCE with a valid tour while
+    # replicas are killed/hung mid-flight — a COUNTER estimator, not a
+    # wall ratio (host noise makes <5% wall gates unmeasurable here;
+    # RPS/p99 ride the artifact unguarded). The healthy value is 1.0 and
+    # MAD over an all-1.0 history is 0, so the tiny absolute band is the
+    # whole gate: any dropped or duplicated request fails the build.
+    "fleet_chaos_answered_rate": MetricRule(
+        direction="higher", rel_threshold=0.0, abs_threshold=0.001, min_samples=4
+    ),
 }
 
 

@@ -61,11 +61,16 @@ ENV_PARENT = "TSP_TRACE_PARENT"
 _HEX = frozenset("0123456789abcdef")
 
 
-def parent_from_env() -> Optional[SpanContext]:
-    """Parse ``TSP_TRACE_PARENT`` into a SpanContext, or None when unset
-    or malformed (a garbled env var must degrade to a fresh root trace,
-    never crash a solver)."""
-    raw = os.environ.get(ENV_PARENT, "").strip().lower()
+def parse_parent_token(raw) -> Optional[SpanContext]:
+    """Parse a ``<trace_id>:<span_id>`` propagation token (the
+    ``TSP_TRACE_PARENT`` encoding) into a SpanContext, or None when
+    missing or malformed — a garbled token must degrade to a fresh root
+    trace, never crash a request. The fleet front stamps this token into
+    each replica-bound request line (``trace_parent`` field), the same
+    contract the env var carries process-to-process."""
+    if not isinstance(raw, str):
+        return None
+    raw = raw.strip().lower()
     if not raw or ":" not in raw:
         return None
     trace_id, _, span_id = raw.partition(":")
@@ -74,6 +79,12 @@ def parent_from_env() -> Optional[SpanContext]:
     if not (set(trace_id) <= _HEX and set(span_id) <= _HEX):
         return None
     return (trace_id, span_id)
+
+
+def parent_from_env() -> Optional[SpanContext]:
+    """Parse ``TSP_TRACE_PARENT`` into a SpanContext, or None when unset
+    or malformed."""
+    return parse_parent_token(os.environ.get(ENV_PARENT, ""))
 
 
 def format_parent(ctx: Optional[SpanContext]) -> Optional[str]:
@@ -307,13 +318,24 @@ def drain_pending() -> List[Dict[str, Any]]:
 def span(
     name: str,
     parent: Optional[SpanContext] = None,
+    announce: bool = False,
     **attrs: Any,
 ) -> Iterator[Any]:
     """Open a span: child of ``parent`` if given, else of the thread's
     current span, else the root of a fresh trace. Yields the Span (or the
     shared null span when tracing is off). An escaping exception is
     recorded as ``attrs.error`` and re-raised — degraded/failed requests
-    still close their spans, so their trees stay complete."""
+    still close their spans, so their trees stay complete.
+
+    ``announce=True`` additionally emits a PROVISIONAL record (same
+    span_id, ``partial: true``, zero duration) at span OPEN. The final
+    record at close overwrites it in reconstruction (``build_trees``
+    keys nodes by span_id, last record wins). This is the fleet
+    contract: a replica process may be killed mid-request, and without
+    the announcement its already-closed child spans (canonicalize,
+    cache.lookup, …) would orphan — the provisional parent keeps every
+    stitched tree complete even when the process that owned the real
+    close dies."""
     if not TRACER.active:
         yield NULL_SPAN
         return
@@ -326,6 +348,20 @@ def span(
         else:
             trace_id, parent_id = _new_id(16), None
     sp = Span(name, trace_id, parent_id, attrs)
+    if announce:
+        TRACER.emit(
+            {
+                "type": "span",
+                "trace_id": sp.trace_id,
+                "span_id": sp.span_id,
+                "parent_id": sp.parent_id,
+                "name": name,
+                "ts": round(sp.ts, 6),
+                "dur_ms": 0.0,
+                "attrs": dict(sp.attrs, partial=True),
+                "events": [],
+            }
+        )
     stack = TRACER._stack()
     stack.append(sp)
     try:

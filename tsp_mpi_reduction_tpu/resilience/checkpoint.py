@@ -38,6 +38,7 @@ import io
 import json
 import os
 import struct
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -46,6 +47,13 @@ from .faults import FaultInjected, registry
 from .health import HEALTH
 
 MAGIC = b"TSPCKPT1"
+#: the process umask, captured once at import (single-threaded) — mkstemp
+#: creates 0600 files, but a PUBLISHED snapshot/cache entry must carry
+#: the same permissions the old ``open(path + ".tmp", "wb")`` writer gave
+#: it, or a store shared between users turns read-denied after this
+#: writer touches it
+_UMASK = os.umask(0)
+os.umask(_UMASK)
 FORMAT_VERSION = 1
 #: rotation depth: how many good snapshots survive (env-overridable)
 DEFAULT_KEEP = 3
@@ -176,26 +184,98 @@ def write_atomic(
     """Publish a snapshot crash-safely: temp file + fsync + rotation shift
     + ``os.replace``. The previous ``keep - 1`` good snapshots survive as
     ``path.1 ... path.{keep-1}``. ``extra_header``: see :func:`pack`."""
+    import tempfile
+
     keep = default_keep() if keep is None else max(1, keep)
     blob = pack(payload, fingerprint, extra_header)
     blob, injected = registry().filter_bytes("ckpt.write", blob)
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(blob)
-        f.flush()
-        os.fsync(f.fileno())
-    # rotation shift: path -> path.1 -> ... (oldest dropped). Done before
-    # the publish so the newest PREVIOUS snapshot is always recoverable.
-    chain = rotation_paths(path, keep)
-    for older, newer in zip(reversed(chain[1:]), reversed(chain[:-1])):
-        if os.path.exists(newer):
-            os.replace(newer, older)
-    os.replace(tmp, path)
+    # UNIQUE same-directory temp name (mkstemp), not a fixed `path.tmp`:
+    # the shared fleet cache tier publishes the same final path from
+    # MULTIPLE processes concurrently, and a fixed temp name lets racer
+    # B truncate the file racer A is about to os.replace into place — a
+    # torn image at the final path, exactly what this writer exists to
+    # prevent. With unique temps every publish replaces a fully-written,
+    # fsync'd image; racers just decide who wins the rename.
+    fd, tmp = tempfile.mkstemp(
+        dir=os.path.dirname(os.path.abspath(path)),
+        prefix=os.path.basename(path) + ".tmp.",
+    )
+    try:
+        os.fchmod(fd, 0o666 & ~_UMASK)  # umask semantics, not mkstemp's 0600
+        with os.fdopen(fd, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        # rotation shift: path -> path.1 -> ... (oldest dropped). Done
+        # before the publish so the newest PREVIOUS snapshot is always
+        # recoverable.
+        chain = rotation_paths(path, keep)
+        for older, newer in zip(reversed(chain[1:]), reversed(chain[:-1])):
+            if os.path.exists(newer):
+                os.replace(newer, older)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
     _fsync_dir(os.path.dirname(os.path.abspath(path)))
     if injected == "truncate":
         # the torn image reached the final path (writer "killed" after the
         # rename was queued) — now crash, as the real failure would
         raise FaultInjected("ckpt.write", "truncate", registry().hits("ckpt.write"))
+
+
+#: per-directory throttle for :func:`maybe_sweep_stale_tmp` (the hot
+#: shared-cache lookup path must not pay a listdir per read)
+_SWEEP_SEEN: Dict[str, float] = {}
+_SWEEP_LOCK = None  # created lazily to keep this module import-light
+
+
+def maybe_sweep_stale_tmp(dirname: str, min_interval_s: float = 300.0) -> int:
+    """Throttled :func:`sweep_stale_tmp`: at most one real sweep per
+    directory per ``min_interval_s`` per process — the read path calls
+    this freely (one dict lookup when throttled)."""
+    global _SWEEP_LOCK
+    if _SWEEP_LOCK is None:
+        import threading
+
+        _SWEEP_LOCK = threading.Lock()
+    now = time.monotonic()
+    with _SWEEP_LOCK:
+        last = _SWEEP_SEEN.get(dirname)
+        if last is not None and now - last < min_interval_s:
+            return 0
+        _SWEEP_SEEN[dirname] = now
+    return sweep_stale_tmp(dirname)
+
+
+def sweep_stale_tmp(dirname: str, max_age_s: float = 60.0) -> int:
+    """Remove orphaned ``*.tmp.*`` files (a writer SIGKILLed between
+    :func:`write_atomic`'s mkstemp and its ``os.replace`` leaves one —
+    the price of the unique temp names concurrent publishers need).
+    Only files older than ``max_age_s`` go: a live publisher's temp
+    exists for milliseconds, so the age bound can never race one.
+    Returns the number removed. Call on opening a long-lived store
+    directory (the fleet's shared cache tier does)."""
+    removed = 0
+    try:
+        names = os.listdir(dirname)
+    except OSError:
+        return 0
+    now = time.time()
+    for name in names:
+        if ".tmp." not in name:
+            continue
+        path = os.path.join(dirname, name)
+        try:
+            if now - os.stat(path).st_mtime > max_age_s:
+                os.unlink(path)
+                removed += 1
+        except OSError:
+            continue  # vanished / racing sweeper: someone else got it
+    return removed
 
 
 def _fsync_dir(dirname: str) -> None:
@@ -227,6 +307,13 @@ def read_with_fallback(
             blob = f.read()
         return registry().filter_bytes("ckpt.read", blob)[0]
 
+    # reading a store is the natural sweep point for temps orphaned by
+    # SIGKILLed writers (chunk campaigns resume every chunk; the fleet
+    # cache tier also sweeps at its own init) — THROTTLED, because the
+    # shared cache tier routes every L2 lookup through here and a
+    # listdir per read would scale lookup cost with directory size;
+    # age-bounded, so a concurrent writer's live temp is never raced
+    maybe_sweep_stale_tmp(os.path.dirname(os.path.abspath(path)))
     # a TRANSIENT read failure (flaky storage, an injected ckpt.read
     # raise) is retried before the candidate is written off — falling
     # back a rotation step over a hiccup would silently discard progress

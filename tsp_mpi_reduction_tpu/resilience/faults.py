@@ -80,6 +80,16 @@ SEAMS = frozenset(
         "cache.get",    # serve: solution-cache lookup
         "cache.put",    # serve: solution-cache insert
         "sched.flush",  # serve: micro-batch scheduler flush (worker body)
+        # fleet seams (ISSUE 11) — crossed by the FRONT once per dispatch
+        # attempt, so ``nth`` counts dispatches fleet-wide. ``raise`` at
+        # the replica seams is translated by the front into the real
+        # action (SIGKILL / SIGSTOP of the target replica process) rather
+        # than propagating — the injected failure is a dead/wedged
+        # REPLICA, not a front crash; front.dispatch stays a normal
+        # transient-fault seam absorbed by the dispatch retry.
+        "replica.kill",   # fleet: kill the dispatch target mid-flight
+        "replica.hang",   # fleet: wedge (SIGSTOP) the dispatch target
+        "front.dispatch", # fleet: one front->replica dispatch attempt
     }
 )
 

@@ -30,6 +30,15 @@ STANDARD_COUNTERS = (
     "stuck_restarts",
     "retries",
     "fallback_restores",
+    # fleet self-healing (ISSUE 11): replica processes restarted by the
+    # supervisor, in-flight requests re-dispatched off a dead/wedged
+    # replica, and requests the front answered locally (cache / greedy)
+    # because the fleet was degraded. Mirrored as the registry metrics
+    # ``fleet_replica_restarts_total`` / ``fleet_redispatches_total`` /
+    # ``fleet_degraded_answers_total{reason=}`` by fleet.front.
+    "fleet_replica_restarts",
+    "fleet_redispatches",
+    "fleet_degraded_answers",
 )
 
 EVENTS_METRIC = "health_events_total"

@@ -80,6 +80,13 @@ class ServiceConfig:
     #: --metrics-port is given) — surfaced in the stats ``obs`` block so
     #: a log line names its own scrape target
     metrics_port: Optional[int] = None
+    #: shared disk-backed cache tier directory (ISSUE 11): when set, the
+    #: instance cache becomes a two-level tier — the in-proc LRU over
+    #: atomic-publish entry files every fleet replica shares, so a
+    #: resubmission hits regardless of which replica solved it and a
+    #: restarted replica warm-fills from the fleet's collective work
+    #: (``fleet.shared_cache.TieredSolutionCache``)
+    shared_cache_dir: Optional[str] = None
     #: per-tier latency objectives (ISSUE 9): tier -> {"target_ms",
     #: "goal"}. Evaluated over THIS session's tier-labeled latency
     #: histograms into the stats ``slo`` block (attainment + error-budget
@@ -99,7 +106,14 @@ class SolveService:
         # shared across worker + request threads; phases mirror into the
         # obs registry alongside every other serve signal
         self.timer = PhaseTimer(mirror_metric="phase_seconds_total")
-        self.cache = SolutionCache(self.cfg.cache_capacity)
+        if self.cfg.shared_cache_dir:
+            from ..fleet.shared_cache import TieredSolutionCache
+
+            self.cache: SolutionCache = TieredSolutionCache(
+                self.cfg.cache_capacity, self.cfg.shared_cache_dir
+            )
+        else:
+            self.cache = SolutionCache(self.cfg.cache_capacity)
         self.scheduler = MicroBatchScheduler(
             max_batch=self.cfg.max_batch,
             max_wait_ms=self.cfg.max_wait_ms,
@@ -162,8 +176,18 @@ class SolveService:
         # lookup, ladder rung, queue wait, the worker's flush) parents
         # back to it, so one serve request = one complete span tree —
         # error/degraded paths included (the finally-emitted root closes
-        # the tree either way)
-        with _tracing.span("serve.request", id=request.get("id")) as root:
+        # the tree either way). A fleet front threads its per-request
+        # ``trace_parent`` token (the TSP_TRACE_PARENT encoding) through
+        # the request line; the root then joins the front's trace, and
+        # is ANNOUNCED at open so a replica killed mid-request cannot
+        # orphan its already-closed child spans (obs.tracing.span).
+        parent = _tracing.parse_parent_token(request.get("trace_parent"))
+        with _tracing.span(
+            "serve.request",
+            parent=parent,
+            announce=parent is not None,
+            id=request.get("id"),
+        ) as root:
             resp = self._handle_traced(request, root)
             root.set("tier", resp.get("tier"))
             if "error" in resp:
@@ -439,6 +463,11 @@ def serve_cli(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--cache-size", type=int, default=4096)
     ap.add_argument("--threads", type=int, default=8)
     ap.add_argument("--default-deadline-ms", type=float, default=1000.0)
+    ap.add_argument("--shared-cache", default=None, metavar="DIR",
+                    help="shared disk-backed cache tier directory (ISSUE "
+                    "11): layers atomic-publish entry files under the "
+                    "in-proc LRU so fleet replicas share one instance "
+                    "cache and restarts warm-fill from it")
     ap.add_argument("--warm", default="",
                     help="comma-separated block sizes to precompile before "
                     "serving (e.g. 8,12,16): every (size, bucket) pair is "
@@ -476,6 +505,7 @@ def serve_cli(argv: Optional[List[str]] = None) -> int:
         threads=args.threads,
         default_deadline_ms=args.default_deadline_ms,
         warm_shapes=warm_shapes,
+        shared_cache_dir=args.shared_cache,
     )
     # ExitStack closes BOTH handles deterministically on every path — with
     # the old two-bare-open form, a failing open of the output leaked the

@@ -128,6 +128,15 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         return serve_cli(argv[1:])
 
+    if argv and argv[0] == "fleet":
+        # fleet mode (README "Fleet serving"): a front process
+        # dispatching the same JSONL contract across N supervised serve
+        # replica subprocesses over a shared cache tier. Same
+        # non-colliding dispatch as "serve".
+        from ..fleet.front import fleet_cli
+
+        return fleet_cli(argv[1:])
+
     try:
         args = build_parser().parse_args(argv)
     except SystemExit as e:
