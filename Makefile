@@ -71,10 +71,15 @@ test-fast:
 bench:
 	$(PY) bench.py
 
-# serving-layer acceptance bench: batched vs sequential throughput, cache
-# hit rate, deadline-ladder behavior -> BENCH_SERVE.json
+# serving-layer acceptance bench: batched vs sequential throughput, the
+# mixed-workload continuous-batching ratio (head-of-line B&B proof
+# preempted into slices vs run to completion), tight-deadline tier
+# routing, cache hit rate -> BENCH_SERVE.json. Chains the history gate
+# so the two governed serve series (serve_service_ratio,
+# serve_tight_deadline_exact_rate) are judged in the same make target.
 bench-serve:
 	TSP_BENCH=serve $(PY) bench.py
+	$(MAKE) bench-check
 
 # atomic-checkpoint overhead vs the legacy direct write -> BENCH_FAULTS.json
 bench-faults:

@@ -40,7 +40,11 @@ def _reset_health_counters():
     (ISSUE 6 satellite): the counters are process-global, so without this
     reset back-to-back tests (and the serve sessions inside them) would
     see each other's recovery counts."""
+    from tsp_mpi_reduction_tpu.perf import compile_cache
     from tsp_mpi_reduction_tpu.resilience.health import HEALTH
 
     HEALTH.reset_for_testing()
+    # the always-on in-process ascent memo (ISSUE 13) must not leak hits
+    # into tests that assert cold-memo behavior
+    compile_cache.ascent_memo_reset_memory()
     yield

@@ -360,7 +360,7 @@ SERVICE_STATS_SCHEMA = {
     "responses": int, "errors": int, "deadline_misses": int,
     "refreshes": int, "rung_failures": dict, "tiers": dict, "cache": dict,
     "scheduler": dict, "phases_s": dict, "health": dict,
-    "compile_cache": dict, "slo": dict, "obs": dict,
+    "compile_cache": dict, "slo": dict, "admission": dict, "obs": dict,
 }
 
 BNB_PAYLOAD_SCHEMA = {

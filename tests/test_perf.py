@@ -182,6 +182,40 @@ def test_ascent_memo_solve_results_identical(perf_dir):
     assert cold.root_lower_bound == warm.root_lower_bound
 
 
+def test_ascent_memo_memory_tier_works_without_disk(perf_off):
+    """The in-process LRU tier (ISSUE 13) answers even with no cache dir
+    enabled — it is what caps the serve scheduler's per-resume overhead,
+    and a resumed slice must not pay the root ascent again just because
+    TSP_COMPILE_CACHE is unset."""
+    d = _d("burma14")
+    pi = np.random.default_rng(1).random(d.shape[0])
+    assert cc.ascent_memo_get(d, "one-tree", 400) is None
+    cc.ascent_memo_put(d, "one-tree", 400, pi)
+    got = cc.ascent_memo_get(d, "one-tree", 400)
+    np.testing.assert_array_equal(got, pi)
+    # returned arrays are COPIES: a caller scribbling on one must not
+    # poison the memo for the next resume
+    got[:] = -1.0
+    np.testing.assert_array_equal(cc.ascent_memo_get(d, "one-tree", 400), pi)
+
+
+def test_ascent_memo_memory_lru_evicts_oldest(perf_off):
+    base = _d("burma14")
+    pi = np.random.default_rng(2).random(base.shape[0])
+    for i in range(cc._ASCENT_MEM_CAP + 1):
+        cc.ascent_memo_put(base + float(i), "one-tree", 400, pi)
+    # the first entry rolled off; the newest survives
+    assert cc.ascent_memo_get(base, "one-tree", 400) is None
+    got = cc.ascent_memo_get(
+        base + float(cc._ASCENT_MEM_CAP), "one-tree", 400
+    )
+    np.testing.assert_array_equal(got, pi)
+    cc.ascent_memo_reset_memory()
+    assert cc.ascent_memo_get(
+        base + float(cc._ASCENT_MEM_CAP), "one-tree", 400
+    ) is None
+
+
 # -- buffer donation -----------------------------------------------------------
 
 

@@ -1,0 +1,379 @@
+"""Iteration-level continuous batching (ISSUE 13): preemptible B&B
+slices, admission control, and the surfaces that ride along.
+
+The core guarantee under test: a B&B proof preempted at arbitrary slice
+boundaries and resumed from its donated checkpoint converges to the SAME
+incumbent, certified lower bound, and tour as one uninterrupted call —
+single-rank and sharded, and even when a checkpoint write is torn by an
+injected fault mid-flight. Everything the scheduler/ladder learned from
+the preemption (partial-latency evidence, queue-age stamps, SLO burn)
+has its own unit coverage here.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from tsp_mpi_reduction_tpu.models import branch_bound as bb
+from tsp_mpi_reduction_tpu.obs import metrics as obs_metrics
+from tsp_mpi_reduction_tpu.obs.slo import BurnMeter
+from tsp_mpi_reduction_tpu.ops.distance import distance_matrix_np
+from tsp_mpi_reduction_tpu.serve.ladder import DeadlineLadder, LatencyEstimator
+from tsp_mpi_reduction_tpu.serve.scheduler import MicroBatchScheduler
+
+#: the shared proof instance: n=12 integer-rounded Euclidean with the
+#: min-out bound and a deliberately small frontier, so the search runs
+#: hundreds of expansion steps (many preemption boundaries) yet proves
+#: in well under a second per leg
+N, SEED = 12, 33
+SOLVE_KW = dict(capacity=256, k=8, inner_steps=1, bound="min-out",
+                mst_prune=False, node_ascent=0, device_loop=False)
+
+
+def _d() -> np.ndarray:
+    rng = np.random.default_rng(SEED)
+    return np.rint(distance_matrix_np(rng.uniform(0, 100, (N, 2))) * 10)
+
+
+def _slice_to_proof(d, path, slice_s=0.02, max_slices=400):
+    """Drive solve_slice to proof, returning (result, slices_taken)."""
+    res, handle = bb.solve_slice(d, slice_s, checkpoint_path=path, **SOLVE_KW)
+    slices = 1
+    while handle is not None:
+        assert slices < max_slices, "sliced solve failed to converge"
+        res, handle = bb.solve_slice(d, slice_s, handle, **SOLVE_KW)
+        slices += 1
+    return res, slices
+
+
+# -- preempt/resume bit-identity -----------------------------------------------
+
+
+def test_solve_slice_bit_identical_vs_uninterrupted(tmp_path):
+    """A proof cut into ~dozens of slices through the donated-checkpoint
+    path lands EXACTLY where the uninterrupted search lands: same proven
+    incumbent, same certified LB, same tour. The slice boundaries are
+    wall-clock (non-deterministic cut points), so this holds only
+    because the restore is bit-exact and the DFS order deterministic."""
+    d = _d()
+    ref = bb.solve(d, **SOLVE_KW)
+    assert ref.proven_optimal
+    res, slices = _slice_to_proof(d, str(tmp_path / "slice.npz"))
+    assert slices >= 2, "instance proved in one slice — nothing preempted"
+    assert res.proven_optimal
+    assert res.cost == ref.cost
+    assert res.lower_bound == ref.lower_bound
+    assert np.array_equal(res.tour, ref.tour)
+
+
+def test_solve_slice_first_slice_requires_checkpoint_path():
+    with pytest.raises(ValueError, match="checkpoint_path"):
+        bb.solve_slice(_d(), 0.05, **SOLVE_KW)
+
+
+def test_solve_slice_handle_reports_progress(tmp_path):
+    """An unproven slice returns a ResumeHandle whose gap_progress is a
+    sane [0, 1] fraction and whose elapsed accumulates across slices —
+    the evidence the ladder's partial-latency estimator consumes."""
+    d = _d()
+    res, handle = bb.solve_slice(
+        d, 1e-3, checkpoint_path=str(tmp_path / "h.npz"), **SOLVE_KW
+    )
+    if handle is None:
+        pytest.skip("instance proved inside the first tiny slice")
+    assert handle.slices == 1
+    assert handle.elapsed_s > 0
+    assert 0.0 <= handle.gap_progress() <= 1.0
+    _, h2 = bb.solve_slice(d, 1e-3, handle, **SOLVE_KW)
+    if h2 is not None:
+        assert h2.slices == 2
+        assert h2.elapsed_s > handle.elapsed_s
+
+
+def test_sharded_chunked_resume_bit_identical():
+    """The sharded analog: a proof preempted into max_iters chunks via
+    checkpoint/resume on a 4-rank virtual mesh converges bit-identically
+    to the uninterrupted sharded solve, with a monotone certified LB
+    across every chunk."""
+    import tempfile
+
+    from test_bnb import make_rank_mesh
+
+    d = _d()
+    mesh = make_rank_mesh(4)
+    kw = dict(capacity_per_rank=256, k=8, inner_steps=1, bound="min-out",
+              mst_prune=False)
+    ref = bb.solve_sharded(d, mesh, **kw)
+    assert ref.proven_optimal
+    with tempfile.TemporaryDirectory() as tmp:
+        ck = os.path.join(tmp, "shard.npz")
+        floors, res = [], None
+        for _chunk in range(60):
+            resume = ck if os.path.exists(ck) else None
+            res = bb.solve_sharded(d, mesh, max_iters=40, checkpoint_path=ck,
+                                   resume_from=resume, **kw)
+            floors.append(res.lower_bound)
+            if res.proven_optimal:
+                break
+    assert res is not None and res.proven_optimal
+    assert len(floors) >= 2, "proof fit one chunk — nothing resumed"
+    assert floors == sorted(floors)
+    assert res.cost == ref.cost
+    assert res.lower_bound == ref.lower_bound
+    assert np.array_equal(res.tour, ref.tour)
+
+
+@pytest.mark.chaos
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+)
+def test_solve_slice_survives_torn_checkpoint_write(tmp_path):
+    """ckpt.write:truncate mid-proof: the slice whose snapshot publish
+    is torn dies with FaultInjected; the retry resumes from the NEWEST
+    VALID snapshot (fallback_restores counts it) and the proof still
+    lands bit-identical to the uninterrupted search."""
+    from tsp_mpi_reduction_tpu.resilience import faults
+    from tsp_mpi_reduction_tpu.resilience.faults import FaultInjected
+    from tsp_mpi_reduction_tpu.resilience.health import HEALTH
+
+    d = _d()
+    ref = bb.solve(d, **SOLVE_KW)
+    path = str(tmp_path / "torn.npz")
+    faults.configure("ckpt.write:truncate,nth=3,seed=5")
+    try:
+        h0 = HEALTH.snapshot()
+        res, handle, slices, crashes = None, None, 0, 0
+        for _ in range(400):
+            try:
+                if res is None:
+                    res, handle = bb.solve_slice(
+                        d, 0.02, checkpoint_path=path, **SOLVE_KW
+                    )
+                else:
+                    res, handle = bb.solve_slice(d, 0.02, handle, **SOLVE_KW)
+                slices += 1
+            except FaultInjected:
+                crashes += 1  # the slice died mid-publish; supervisor retries
+                continue
+            if handle is None:
+                break
+        hits = faults.registry().hits("ckpt.write")
+    finally:
+        faults.clear()
+    assert hits > 0, "ckpt.write seam never crossed"
+    assert crashes >= 1
+    assert res is not None and res.proven_optimal
+    assert HEALTH.snapshot()["fallback_restores"] > h0["fallback_restores"]
+    assert res.cost == ref.cost
+    assert res.lower_bound == ref.lower_bound
+    assert np.array_equal(res.tour, ref.tour)
+
+
+# -- the scheduler's iteration-level loop --------------------------------------
+
+
+def test_submit_bnb_preempts_resumes_and_interleaves_hk(tmp_path):
+    """One proof on the device loop with HK tickets arriving mid-flight:
+    the proof is preempted at slice boundaries (counted + re-queued),
+    the HK batch is admitted into the gaps, and the final job result is
+    the proven optimum — identical to a direct solve."""
+    d = _d()
+    ref = bb.solve(d, **SOLVE_KW)
+    rng = np.random.default_rng(9)
+    hk_d = distance_matrix_np(rng.uniform(0, 100, (8, 2)))
+    with MicroBatchScheduler(max_batch=8, max_wait_ms=5.0) as sched:
+        job = sched.submit_bnb(
+            d, budget_s=60.0, slice_s=0.02,
+            checkpoint_path=str(tmp_path / "job.npz"), solve_kw=SOLVE_KW,
+        )
+        # tickets submitted while the proof holds the device: they must
+        # be answered from the admit gaps, not after the proof
+        tickets = [sched.submit(hk_d[None]) for _ in range(3)]
+        got = [t.wait(timeout=60.0) for t in tickets]
+        res = job.wait(timeout=60.0)
+        stats = sched.stats()
+    assert all(g is not None for g in got)
+    assert res is not None and res.proven_optimal
+    assert res.cost == ref.cost
+    assert np.array_equal(res.tour, ref.tour)
+    assert stats["bnb_jobs"] == 1
+    assert stats["bnb_slices"] >= 2
+    assert stats["bnb_preemptions"] >= 1
+    assert stats["bnb_resumes"] >= 1
+    assert job.preemptions >= 1 and job.resumes >= 1
+
+
+def test_submit_bnb_validation_is_synchronous(tmp_path):
+    with MicroBatchScheduler(max_batch=4) as sched:
+        with pytest.raises(ValueError, match="distance matrix"):
+            sched.submit_bnb(np.ones((3, 4)), budget_s=1.0, slice_s=0.1,
+                             checkpoint_path=str(tmp_path / "x.npz"))
+        with pytest.raises(ValueError, match="n >= 3"):
+            sched.submit_bnb(np.ones((2, 2)), budget_s=1.0, slice_s=0.1,
+                             checkpoint_path=str(tmp_path / "x.npz"))
+        with pytest.raises(ValueError, match="must be > 0"):
+            sched.submit_bnb(np.ones((4, 4)), budget_s=0.0, slice_s=0.1,
+                             checkpoint_path=str(tmp_path / "x.npz"))
+        with pytest.raises(ValueError, match="checkpoint_path"):
+            sched.submit_bnb(np.ones((4, 4)), budget_s=1.0, slice_s=0.1,
+                             checkpoint_path="")
+
+
+def test_ticket_queue_age_stamped_at_flush():
+    """The worker stamps every flushed ticket's queue wait — the number
+    the ladder subtracts so its EWMA learns service time (and the
+    serve_queue_age_seconds histogram observes)."""
+    rng = np.random.default_rng(2)
+    d = distance_matrix_np(rng.uniform(0, 100, (8, 2)))
+    before = obs_metrics.REGISTRY.snapshot(prefix="serve_queue_age_seconds")
+    with MicroBatchScheduler(max_batch=2, max_wait_ms=2.0) as sched:
+        t = sched.submit(d[None])
+        assert t.wait(timeout=30.0) is not None
+    assert t.queue_age_s is not None and t.queue_age_s >= 0.0
+    delta = obs_metrics.REGISTRY.delta(
+        before, prefix="serve_queue_age_seconds"
+    )
+    series = delta.data.get("serve_queue_age_seconds", {}).get("series", {})
+    counts = [
+        h["count"] for h in series.values() if isinstance(h, dict)
+    ]
+    assert sum(counts) >= 1
+
+
+# -- ladder learning -----------------------------------------------------------
+
+
+def test_estimator_observe_partial_projects_full_cost():
+    """A rung preempted at 25% gap closure after 1s teaches ~4s — the
+    projection — not the 1s it was allowed to run; zero progress is
+    clamped to cap_factor x elapsed, not infinity."""
+    est = LatencyEstimator()
+    est.observe_partial("bnb", 12, 1.0, 0.25)
+    assert est.estimate("bnb", 12, 0.0) == pytest.approx(4.0)
+    est2 = LatencyEstimator()
+    est2.observe_partial("bnb", 12, 1.0, 0.0, cap_factor=64.0)
+    assert est2.estimate("bnb", 12, 0.0) == pytest.approx(64.0)
+    est3 = LatencyEstimator()
+    est3.observe_partial("bnb", 12, 0.0, 0.5)  # no elapsed: no evidence
+    assert est3.estimate("bnb", 12, -1.0) == -1.0
+
+
+def test_attempt_feeds_service_time_not_queue_wait():
+    """_attempt subtracts the rung's scheduler queue wait before feeding
+    the EWMA: one head-of-line episode must not pin later tight-deadline
+    requests to greedy after the queue has drained. A rung that TIMES
+    OUT keeps its full elapsed (the budget was really burned)."""
+    ladder = DeadlineLadder(scheduler=None)
+
+    def run_with_wait():
+        time.sleep(0.03)
+        ladder._tls.queue_wait = 10.0  # pretend it all sat in the queue
+        return "ok"
+
+    assert ladder._attempt("pipeline", 8, run_with_wait) == "ok"
+    # elapsed (~30 ms) minus claimed queue wait clamps to ~0 service time
+    assert ladder.estimator.estimate("pipeline", 8, 99.0) < 0.01
+
+    ladder2 = DeadlineLadder(scheduler=None)
+
+    def run_timeout():
+        time.sleep(0.03)
+        return None  # rung timed out: no ticket, no queue-wait stamp
+
+    assert ladder2._attempt("pipeline", 8, run_timeout) is None
+    assert ladder2.estimator.estimate("pipeline", 8, 0.0) >= 0.03
+
+
+# -- SLO burn meter ------------------------------------------------------------
+
+
+def test_burn_meter_no_verdict_below_min_count():
+    bm = BurnMeter({"greedy": {"target_ms": 50.0, "goal": 0.9}}, min_count=4)
+    for _ in range(3):
+        bm.observe("greedy", 1.0)
+    assert bm.burn("greedy") is None  # no shedding on no evidence
+    assert bm.burn("unknown-tier") is None
+    snap = bm.snapshot()
+    assert snap["greedy"] == {"requests": 3, "burn_rate": None}
+
+
+def test_burn_meter_burn_rate_and_window_rolloff():
+    bm = BurnMeter(
+        {"greedy": {"target_ms": 50.0, "goal": 0.9}}, window=8, min_count=4
+    )
+    # 4 misses out of 4: miss fraction 1.0 over budget 0.1 -> burn 10x
+    for _ in range(4):
+        bm.observe("greedy", 1.0)
+    assert bm.burn("greedy") == pytest.approx(10.0)
+    # 8 fast answers roll every miss out of the window -> burn 0
+    for _ in range(8):
+        bm.observe("greedy", 0.001)
+    assert bm.burn("greedy") == pytest.approx(0.0)
+    assert bm.snapshot()["greedy"]["requests"] == 8
+
+
+def test_burn_meter_rejects_bad_window():
+    with pytest.raises(ValueError, match="window"):
+        BurnMeter(window=0)
+
+
+# -- queue-age histogram quantiles ---------------------------------------------
+
+
+def test_hist_quantile_interpolates_and_clamps():
+    hist = {"count": 10, "buckets": [1.0, 2.0, 4.0], "counts": [5, 5, 0]}
+    assert obs_metrics.hist_quantile(hist, 0.5) == pytest.approx(1.0)
+    # rank 7.5 of 10: 2.5 into the 5-count (1, 2] bucket -> 1.5
+    assert obs_metrics.hist_quantile(hist, 0.75) == pytest.approx(1.5)
+    assert obs_metrics.hist_quantile(hist, 1.0) == pytest.approx(2.0)
+    assert obs_metrics.hist_quantile({"count": 0}, 0.5) is None
+    assert obs_metrics.hist_quantile(hist, 0.0) is None
+    assert obs_metrics.hist_quantile(hist, 1.5) is None
+    # +Inf-bucket observations clamp to the last finite edge
+    tail = {"count": 4, "buckets": [1.0, 2.0], "counts": [1, 0]}
+    assert obs_metrics.hist_quantile(tail, 0.99) == pytest.approx(2.0)
+
+
+# -- stats JSON + report tool --------------------------------------------------
+
+
+def test_service_stats_admission_block(tmp_path, capsys):
+    """The service's stats JSON carries the admission block (per-tier
+    burn, preemption counters, queue-age percentiles) and obs_report
+    --serve renders it; a payload without one is exit 2."""
+    from tsp_mpi_reduction_tpu.serve.service import ServiceConfig, SolveService
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+    import obs_report
+
+    rng = np.random.default_rng(4)
+    with SolveService(ServiceConfig(max_batch=4, max_wait_ms=2.0)) as svc:
+        for i in range(5):
+            resp = svc.handle({
+                "id": i, "xy": rng.uniform(0, 100, (8, 2)).tolist(),
+                "deadline_ms": 900.0,
+            })
+            assert "error" not in resp
+        stats_line = svc.stats_json()
+    adm = json.loads(stats_line)["admission"]
+    assert set(adm) >= {
+        "burn", "slo_sheds", "preemptions", "resumes", "admit_flushes",
+        "queue_age_s",
+    }
+    assert adm["burn"]["pipeline"]["requests"] == 5
+    assert adm["queue_age_s"]["count"] >= 5
+    assert adm["queue_age_s"]["p50"] is not None
+
+    good = tmp_path / "serve_stats.json"
+    good.write_text(stats_line + "\n")
+    assert obs_report.main(["--serve", str(good)]) == 0
+    out = capsys.readouterr().out
+    assert "burn pipeline:" in out and "queue age:" in out
+    # a pre-iteration-level payload (no admission block) is exit 2
+    bad = tmp_path / "old_stats.json"
+    bad.write_text(json.dumps({"responses": 1, "cache": {}}) + "\n")
+    assert obs_report.main(["--serve", str(bad)]) == 2

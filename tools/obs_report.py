@@ -328,6 +328,82 @@ def render_fleet(path: str) -> str:
     return "\n".join(out)
 
 
+def render_serve(path: str) -> str:
+    """Render a serve stats line's admission/preemption block (ISSUE 13):
+    per-tier SLO burn rate, the iteration-level loop's preemption /
+    resume / shed counters, flush-cause mix, and queue-age percentiles.
+
+    A payload WITHOUT an ``admission`` block is an error (exit 2), not an
+    empty section — the caller explicitly asked for admission-control
+    attribution, and a pre-iteration-level stats line (or a hand-rolled
+    JSON) carries none (same posture as ``--ranks`` / ``--fleet``)."""
+    out: List[str] = []
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                doc = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            adm = doc.get("admission") if isinstance(doc, dict) else None
+            if not adm:
+                continue
+            sched = doc.get("scheduler") or {}
+            out.append(
+                f"== serve {path}: {doc.get('responses', 0)} responses, "
+                f"{doc.get('errors', 0)} errors, "
+                f"{doc.get('deadline_misses', 0)} deadline misses =="
+            )
+            burn = adm.get("burn", {})
+            for tier in sorted(burn):
+                row = burn[tier]
+                if not isinstance(row, dict):
+                    continue
+                b = row.get("burn_rate")
+                burn_txt = f"{b:.3f}" if isinstance(b, (int, float)) else (
+                    "n/a (below min_count)"
+                )
+                out.append(
+                    f"  burn {tier}: requests {row.get('requests', 0)}  "
+                    f"burn rate {burn_txt}"
+                )
+            out.append(
+                f"  preemption: jobs {sched.get('bnb_jobs', 0)}  "
+                f"slices {sched.get('bnb_slices', 0)}  "
+                f"preemptions {adm.get('preemptions', 0)}  "
+                f"resumes {adm.get('resumes', 0)}"
+            )
+            out.append(
+                f"  admission: admit flushes {adm.get('admit_flushes', 0)}  "
+                f"slo sheds {adm.get('slo_sheds', 0)}  "
+                f"flush causes full {sched.get('full_flushes', 0)} / "
+                f"wait {sched.get('wait_flushes', 0)} / "
+                f"admit {sched.get('admit_flushes', 0)}"
+            )
+            qage = adm.get("queue_age_s") or {}
+            if qage.get("count"):
+                pct = "  ".join(
+                    f"{q} {qage[q] * 1000:.1f} ms"
+                    for q in ("p50", "p90", "p99")
+                    if isinstance(qage.get(q), (int, float))
+                )
+                out.append(
+                    f"  queue age: count {qage['count']}  {pct}"
+                )
+            else:
+                out.append("  queue age: (no flushed tickets)")
+    if not out:
+        raise ValueError(
+            f"no admission block in {path!r} — this renderer reads the "
+            "serve stats JSON (SolveService.stats_json / the serve CLI's "
+            "--stats line); payloads from before the iteration-level "
+            "scheduler carry no admission-control attribution"
+        )
+    return "\n".join(out)
+
+
 def render_metrics(path: str, top: int = 20) -> str:
     with open(path, encoding="utf-8") as fh:
         data = json.load(fh)
@@ -367,14 +443,22 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "per-replica scrape totals, supervision counters, "
                     "shared-cache tier, fleet SLO attainment; errors "
                     "(exit 2) when the payload has no fleet block")
+    ap.add_argument("--serve", default=None,
+                    help="serve stats JSON (line file ok) — per-tier SLO "
+                    "burn, preemption/resume counters, flush-cause mix, "
+                    "queue-age percentiles; errors (exit 2) when the "
+                    "payload has no admission block")
     ap.add_argument("--metrics", default=None, help="/metrics.json dump")
     ap.add_argument("--limit", type=int, default=None,
                     help="max traces to render")
     args = ap.parse_args(argv)
-    if not (args.trace or args.series or args.ranks or args.fleet or args.metrics):
+    if not (
+        args.trace or args.series or args.ranks or args.fleet
+        or args.serve or args.metrics
+    ):
         ap.error(
             "give at least one of --trace / --series / --ranks / --fleet "
-            "/ --metrics"
+            "/ --serve / --metrics"
         )
     sections = []
     try:
@@ -386,6 +470,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             sections.append(render_ranks(args.ranks))
         if args.fleet:
             sections.append(render_fleet(args.fleet))
+        if args.serve:
+            sections.append(render_serve(args.serve))
         if args.metrics:
             sections.append(render_metrics(args.metrics))
     except (OSError, ValueError) as e:

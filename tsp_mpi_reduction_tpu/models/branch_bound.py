@@ -2470,6 +2470,90 @@ def solve(
     )
 
 
+@dataclass
+class ResumeHandle:
+    """Continuation token for a step-sliced :func:`solve` (the serve
+    scheduler's preemption handle, ISSUE 13).
+
+    A handle means the search stopped UNPROVEN with a resumable snapshot
+    at ``checkpoint_path`` (solve() always saves one when it stops early
+    with a checkpoint path set). Passing the handle back to
+    :func:`solve_slice` continues the identical search: the frontier,
+    incumbent, reservoir and certified-LB floor restore bit-for-bit, the
+    ILS seeding is skipped, and the DFS expansion order is deterministic
+    — so a sliced solve converges to the same incumbent, tour and
+    certified bound as one uninterrupted call (tests/test_serve_preempt).
+
+    The progress fields feed the ladder's partial-latency estimator:
+    ``first_lower_bound`` is the root bound after the first slice, so
+    ``(lower_bound - first_lower_bound) / (incumbent - first_lower_bound)``
+    measures how much of the certification gap the search has closed.
+    """
+
+    checkpoint_path: str
+    slices: int
+    elapsed_s: float
+    incumbent: float
+    lower_bound: float
+    first_lower_bound: float
+
+    def gap_progress(self) -> float:
+        """Fraction of the certification gap closed so far, in [0, 1]."""
+        span = self.incumbent - self.first_lower_bound
+        if not np.isfinite(span) or span <= 0:
+            return 0.0
+        return float(
+            min(max((self.lower_bound - self.first_lower_bound) / span, 0.0), 1.0)
+        )
+
+
+def solve_slice(
+    d: np.ndarray,
+    slice_s: float,
+    handle: Optional[ResumeHandle] = None,
+    *,
+    checkpoint_path: Optional[str] = None,
+    **solve_kw,
+) -> Tuple[BnBResult, Optional[ResumeHandle]]:
+    """Run at most ``slice_s`` seconds of :func:`solve`, preemptibly.
+
+    First slice: pass ``checkpoint_path`` (where the donated snapshot
+    lives between slices). Later slices: pass the returned handle back.
+    Returns ``(result, handle)`` — ``handle is None`` means the search
+    PROVED optimality and the result is final; otherwise ``result`` is
+    the best-so-far (cost + certified ``lower_bound``) and ``handle``
+    resumes exactly where this slice stopped. ``solve_kw`` is forwarded
+    to :func:`solve` verbatim and must be identical across slices (the
+    checkpoint pins ``d`` and ``bound``; the rest shapes the search and
+    a mid-flight change would fork the trajectory)."""
+    path = handle.checkpoint_path if handle is not None else checkpoint_path
+    if not path:
+        raise ValueError("solve_slice needs a checkpoint_path for its first slice")
+    t0 = time.perf_counter()
+    res = solve(
+        d,
+        time_limit_s=max(float(slice_s), 1e-3),
+        checkpoint_path=path,
+        resume_from=path if handle is not None else None,
+        **solve_kw,
+    )
+    elapsed = time.perf_counter() - t0
+    if res.proven_optimal:
+        return res, None
+    return res, ResumeHandle(
+        checkpoint_path=path,
+        slices=(handle.slices if handle is not None else 0) + 1,
+        elapsed_s=(handle.elapsed_s if handle is not None else 0.0) + elapsed,
+        incumbent=float(res.cost),
+        lower_bound=float(res.lower_bound),
+        first_lower_bound=(
+            handle.first_lower_bound
+            if handle is not None
+            else float(res.root_lower_bound)
+        ),
+    )
+
+
 def _rank_counts(count) -> np.ndarray:
     """Host copy of a sharded frontier's per-rank count vector — [R] int32,
     tens of bytes: the one per-round scalar-class readback the sharded

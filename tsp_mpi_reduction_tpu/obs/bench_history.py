@@ -304,6 +304,18 @@ DEFAULT_RULES: Dict[str, MetricRule] = {
     "fleet_chaos_answered_rate": MetricRule(
         direction="higher", rel_threshold=0.0, abs_threshold=0.001, min_samples=4
     ),
+    # iteration-level serving (ISSUE 13, TSP_BENCH=serve): the
+    # mixed-workload continuous-batching ratio — short-request completion
+    # throughput with a head-of-line proof preempted into slices vs run
+    # to completion. A wall ratio on a contended host, so a relative band
+    "serve_service_ratio": MetricRule(direction="higher", rel_threshold=0.15),
+    # fraction of feasible-tight-deadline requests answered by an exact
+    # rung (certified_gap == 0): a COUNTER estimator whose healthy value
+    # is 1.0 (MAD over an all-1.0 history is 0) — the small absolute band
+    # is the whole gate, any tier-routing regression fails the build
+    "serve_tight_deadline_exact_rate": MetricRule(
+        direction="higher", rel_threshold=0.0, abs_threshold=0.02, min_samples=4
+    ),
 }
 
 

@@ -36,6 +36,13 @@ _KINDS = ("counter", "gauge", "histogram")
 
 
 def _label_key(labels: Mapping[str, Any]) -> LabelKey:
+    # the serve hot path records 4-6 series per request, almost all with
+    # zero or one label — skip the sort (and its genexp frame) for those
+    if not labels:
+        return ()
+    if len(labels) == 1:
+        [(k, v)] = labels.items()
+        return ((k, str(v)),)
     return tuple(sorted((k, str(v)) for k, v in labels.items()))
 
 
@@ -270,6 +277,31 @@ class MetricsRegistry:
 
 #: the process-global registry every layer records into
 REGISTRY = MetricsRegistry()
+
+
+def hist_quantile(hist: Mapping[str, Any], q: float) -> Optional[float]:
+    """Bucket-interpolated quantile from a histogram dict
+    (:meth:`_Hist.as_dict` shape — also what :meth:`Snapshot.series`
+    yields for histograms). Linear interpolation inside the bucket the
+    quantile falls in, the same honesty trade as ``obs.slo``'s
+    attainment; observations in the +Inf bucket clamp to the last finite
+    edge (a quantile cannot invent an upper bound the histogram never
+    recorded). None when empty or ``q`` is out of (0, 1]."""
+    count = int(hist.get("count", 0))
+    if count <= 0 or not 0.0 < q <= 1.0:
+        return None
+    buckets = list(hist.get("buckets", ()))
+    counts = list(hist.get("counts", ()))
+    rank = q * count
+    cum = 0.0
+    lo = 0.0
+    for edge, c in zip(buckets, counts):
+        if cum + c >= rank:
+            frac = (rank - cum) / c if c > 0 else 0.0
+            return lo + (edge - lo) * frac
+        cum += c
+        lo = edge
+    return buckets[-1] if buckets else None
 
 
 # -- Prometheus text exposition ------------------------------------------------

@@ -27,6 +27,8 @@ checks all read verdicts, not raw histograms.
 
 from __future__ import annotations
 
+import threading
+from collections import deque
 from typing import Any, Dict, Optional
 
 #: default per-tier objectives: generous enough that a healthy CPU serve
@@ -68,6 +70,77 @@ def hist_attainment(hist: Dict[str, Any], target_s: float) -> Optional[float]:
         # unless the target is infinite
         pass
     return min(attained / count, 1.0)
+
+
+class BurnMeter:
+    """LIVE windowed burn-rate estimator — the admission-control signal.
+
+    :func:`evaluate` judges a whole serve session retrospectively from
+    histograms; admission control needs the burn rate NOW, over recent
+    traffic only, so a bad first minute doesn't shed requests an hour
+    later. This keeps a bounded deque of the last ``window`` latencies
+    per answering tier and computes the same burn definition over it:
+
+        burn = (fraction of recent answers over target) / (1 - goal)
+
+    ``burn() is None`` until ``min_count`` answers have landed in a
+    tier's window — no shedding on no evidence. The serve scheduler
+    reads :meth:`burn` to prioritize a burning tier's queue and the
+    ladder reads it to shed/degrade new admissions to that tier
+    (``LadderConfig.slo_shed_burn``); :meth:`snapshot` rides the stats
+    JSON ``admission`` block. Thread-safe; O(window) reads on arrays of
+    ~tens of floats."""
+
+    def __init__(
+        self,
+        slos: Optional[Dict[str, Dict[str, float]]] = None,
+        window: int = 64,
+        min_count: int = 8,
+    ) -> None:
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        self.slos = DEFAULT_SLOS if slos is None else slos
+        self.window = window
+        self.min_count = max(int(min_count), 1)
+        self._lock = threading.Lock()
+        self._lat: Dict[str, deque] = {}
+
+    def observe(self, tier: str, latency_s: float) -> None:
+        with self._lock:
+            dq = self._lat.get(tier)
+            if dq is None:
+                dq = self._lat[tier] = deque(maxlen=self.window)
+            dq.append(float(latency_s))
+
+    def burn(self, tier: str) -> Optional[float]:
+        """Live error-budget burn rate for ``tier``; None without an
+        objective or with fewer than ``min_count`` windowed answers."""
+        obj = self.slos.get(tier)
+        if obj is None:
+            return None
+        with self._lock:
+            dq = self._lat.get(tier)
+            if dq is None or len(dq) < self.min_count:
+                return None
+            lat = list(dq)
+        target_s = float(obj["target_ms"]) / 1000.0
+        missed = sum(1 for v in lat if v > target_s)
+        budget = max(1.0 - float(obj["goal"]), 1e-9)
+        return (missed / len(lat)) / budget
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Per-tier ``{requests, burn_rate}`` over the live window (the
+        stats JSON ``admission.burn`` block)."""
+        with self._lock:
+            sizes = {t: len(dq) for t, dq in self._lat.items()}
+        out: Dict[str, Any] = {}
+        for tier in sorted(set(self.slos) | set(sizes)):
+            b = self.burn(tier)
+            out[tier] = {
+                "requests": sizes.get(tier, 0),
+                "burn_rate": round(b, 4) if b is not None else None,
+            }
+        return out
 
 
 def evaluate(

@@ -44,6 +44,7 @@ import json
 import os
 import threading
 import time
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional, Tuple
 
@@ -529,6 +530,15 @@ def _atomic_write(path: str, payload: bytes) -> None:
 
 # -- host-setup memo (deterministic f64 ascent potentials) ---------------------
 
+#: in-process LRU over the same keys as the disk memo, ALWAYS on (the
+#: disk tier needs TSP_COMPILE_CACHE). Added for iteration-level serving
+#: (ISSUE 13): a preempted B&B slice resumes through ``solve()`` again,
+#: and re-running the ~400-step root ascent per resume costs more than
+#: the slice itself — a same-process resume must pay a dict lookup.
+_ASCENT_MEM_CAP = 32
+_ascent_mem: "OrderedDict[str, np.ndarray]" = OrderedDict()
+_ascent_mem_lock = threading.Lock()
+
 
 def _ascent_path(key: str) -> str:
     return os.path.join(_enabled_dir or "", "setup", f"{key}.npy")
@@ -542,14 +552,44 @@ def ascent_key(d: np.ndarray, bound: str, steps: int) -> str:
     return h.hexdigest()
 
 
+def ascent_memo_reset_memory() -> None:
+    """Drop the in-process ascent LRU (tests/conftest.py per-test
+    boundary — the always-on memory tier must not leak hits across
+    tests that assert cold-memo behavior)."""
+    with _ascent_mem_lock:
+        _ascent_mem.clear()
+
+
+def _ascent_mem_get(key: str) -> Optional[np.ndarray]:
+    with _ascent_mem_lock:
+        pi = _ascent_mem.get(key)
+        if pi is not None:
+            _ascent_mem.move_to_end(key)
+    return pi
+
+
+def _ascent_mem_put(key: str, pi: np.ndarray) -> None:
+    with _ascent_mem_lock:
+        _ascent_mem[key] = pi
+        _ascent_mem.move_to_end(key)
+        while len(_ascent_mem) > _ASCENT_MEM_CAP:
+            _ascent_mem.popitem(last=False)
+
+
 def ascent_memo_get(d: np.ndarray, bound: str, steps: int) -> Optional[np.ndarray]:
     """Memoized f64 root-ascent potentials, or None. The key covers the
     exact distance bytes + bound mode + step count, and the stored value
     is the byte-exact output of the same deterministic computation — a
-    hit cannot change any solver result."""
+    hit cannot change any solver result. Two tiers: the in-process LRU
+    (always on), then the disk memo (when enabled)."""
+    key = ascent_key(d, bound, steps)
+    pi = _ascent_mem_get(key)
+    if pi is not None:
+        STATS.incr("ascent_memo_hits")
+        return pi.copy()
     if _enabled_dir is None:
         return None
-    path = _ascent_path(ascent_key(d, bound, steps))
+    path = _ascent_path(key)
     if not os.path.exists(path):
         STATS.incr("ascent_memo_misses")
         return None
@@ -562,18 +602,23 @@ def ascent_memo_get(d: np.ndarray, bound: str, steps: int) -> Optional[np.ndarra
         STATS.incr("ascent_memo_misses")  # key collision paranoia: recompute
         return None
     STATS.incr("ascent_memo_hits")
-    return np.asarray(pi, np.float64)
+    pi = np.asarray(pi, np.float64)
+    _ascent_mem_put(key, pi.copy())
+    return pi
 
 
 def ascent_memo_put(d: np.ndarray, bound: str, steps: int, pi: np.ndarray) -> None:
+    pi = np.asarray(pi, np.float64)
+    key = ascent_key(d, bound, steps)
+    _ascent_mem_put(key, pi.copy())
     if _enabled_dir is None:
         return
     import io
 
     buf = io.BytesIO()
-    np.save(buf, np.asarray(pi, np.float64))
+    np.save(buf, pi)
     try:
-        _atomic_write(_ascent_path(ascent_key(d, bound, steps)), buf.getvalue())
+        _atomic_write(_ascent_path(key), buf.getvalue())
     except OSError:
         pass  # memo is an optimization; never fail a solve over it
 

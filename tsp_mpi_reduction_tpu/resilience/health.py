@@ -39,6 +39,15 @@ STANDARD_COUNTERS = (
     "fleet_replica_restarts",
     "fleet_redispatches",
     "fleet_degraded_answers",
+    # iteration-level serving (ISSUE 13): B&B rungs preempted at a slice
+    # boundary via the donated checkpoint path, those slices resumed
+    # bit-identically, and new admissions shed/degraded by the live SLO
+    # burn signal. Mirrored as ``serve_bnb_preemptions_total`` /
+    # ``serve_bnb_resumes_total`` / ``serve_flushes_total{cause=slo_shed}``
+    # by serve.scheduler.
+    "bnb_preemptions",
+    "bnb_resumes",
+    "slo_sheds",
 )
 
 EVENTS_METRIC = "health_events_total"

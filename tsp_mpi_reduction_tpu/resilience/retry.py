@@ -53,7 +53,12 @@ class RetryPolicy:
         non-retryable exceptions propagate immediately."""
         if self.max_attempts < 1:
             raise ValueError(f"max_attempts must be >= 1, got {self.max_attempts}")
-        rng = random.Random(self.seed)
+        # the jitter RNG is built lazily, on the first retry: the no-fault
+        # fast path (every request's cache lookup and rung attempt goes
+        # through here) must not pay the Mersenne seeding, and the retry
+        # schedule stays byte-identical — the first delay still comes from
+        # a fresh Random(seed)
+        rng: Optional[random.Random] = None
         t0 = time.monotonic()
         attempt = 0
         while True:
@@ -63,6 +68,8 @@ class RetryPolicy:
             except self.retry_on as exc:
                 if attempt >= self.max_attempts:
                     raise
+                if rng is None:
+                    rng = random.Random(self.seed)
                 delay = self.delay_s(attempt, rng)
                 if (
                     budget_s is not None

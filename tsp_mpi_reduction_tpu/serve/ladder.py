@@ -7,7 +7,11 @@ whose *estimated* latency fits the remaining budget:
 
   ``bnb``      certified branch & bound (``models.branch_bound.solve``,
                time-limited to the budget) — proven optimum or a certified
-               gap from the search's global lower bound;
+               gap from the search's global lower bound. Runs PREEMPTIBLY
+               through the scheduler's iteration-level loop (ISSUE 13):
+               ``bnb_slice_s``-second slices that yield the device between
+               checkpointed continuations, so a long proof coexists with
+               the latency-sensitive pipeline traffic;
   ``pipeline`` the exact vmapped Held-Karp path through the micro-batch
                scheduler: single block for n <= 16 (exact, gap 0), blocked
                decomposition + merge fold + device 2-opt/Or-opt polish for
@@ -29,6 +33,8 @@ answer is never clobbered by a later deadline-degraded one.
 
 from __future__ import annotations
 
+import os
+import tempfile
 import threading
 import time
 from dataclasses import dataclass, field
@@ -78,8 +84,19 @@ class LadderConfig:
     bnb_capacity: int = 1 << 14
     bnb_k: int = 64
     #: injectable certified solver (tests); signature (d, time_limit_s) ->
-    #: (cost, closed_tour, lower_bound, proven)
+    #: (cost, closed_tour, lower_bound, proven). When set, the rung runs
+    #: the solver inline; the default rung instead rides the scheduler's
+    #: iteration-level loop (``submit_bnb``) in preemptible slices
     bnb_solver: Optional[Callable] = None
+    #: preemption granularity of the default bnb rung: each device slice
+    #: runs at most this long before yielding to other queued work
+    bnb_slice_s: float = 0.25
+    #: where between-slice checkpoints live (None: a private temp dir,
+    #: removed by ``DeadlineLadder.cleanup``)
+    bnb_checkpoint_dir: Optional[str] = None
+    #: shed/degrade NEW admissions to a tier whose live error-budget burn
+    #: rate (obs.slo.BurnMeter) exceeds this (1.0 = exactly on budget)
+    slo_shed_burn: float = 2.0
     #: 2-opt/Or-opt polish rounds for the blocked-pipeline rung
     polish_rounds: int = 6
     #: transient-fault retries per rung attempt (the self-healing knob:
@@ -117,6 +134,29 @@ class LatencyEstimator:
         with self._lock:
             return self._ewma.get((tier, self._bucket(n)), default)
 
+    def observe_partial(
+        self,
+        tier: str,
+        n: int,
+        elapsed_s: float,
+        progress: float,
+        cap_factor: float = 64.0,
+    ) -> None:
+        """Learn from a PREEMPTED / unfinished rung (ISSUE 13 satellite).
+
+        A rung cut off at its deadline used to be recorded at its capped
+        elapsed time — systematically UNDER-estimating the tier's true
+        cost, so the ladder kept over-promising it. This projects the
+        full cost from the partial evidence: ``elapsed / progress``
+        (progress = fraction of the work done, e.g. the B&B gap closure
+        from ``ResumeHandle.gap_progress``), clamped to at most
+        ``cap_factor`` x elapsed so a rung with no measurable progress
+        teaches a strong-but-bounded penalty instead of infinity."""
+        if elapsed_s <= 0:
+            return
+        p = min(max(progress, 1.0 / cap_factor), 1.0)
+        self.observe(tier, n, min(elapsed_s / p, elapsed_s * cap_factor))
+
 
 def _trivial_tour(n: int, d: np.ndarray) -> Tuple[float, np.ndarray]:
     """n < 3: the only closed tours there are."""
@@ -141,22 +181,6 @@ def _largest_block_divisor(n: int) -> Optional[int]:
     return None
 
 
-def _default_bnb_solver(cfg: LadderConfig) -> Callable:
-    from ..models import branch_bound as bb
-
-    def run(d: np.ndarray, time_limit_s: float):
-        res = bb.solve(
-            d,
-            capacity=cfg.bnb_capacity,
-            k=cfg.bnb_k,
-            time_limit_s=max(time_limit_s, 0.05),
-            device_loop=False,  # fine-grained time-limit checks
-        )
-        return res.cost, res.tour, res.lower_bound, bool(res.proven_optimal)
-
-    return run
-
-
 class DeadlineLadder:
     """Stateful rung dispatcher shared by all request threads."""
 
@@ -165,15 +189,55 @@ class DeadlineLadder:
         scheduler: MicroBatchScheduler,
         cfg: Optional[LadderConfig] = None,
         estimator: Optional[LatencyEstimator] = None,
+        burn_meter=None,
     ) -> None:
         self.scheduler = scheduler
         self.cfg = cfg or LadderConfig()
         self.estimator = estimator or LatencyEstimator()
+        #: optional obs.slo.BurnMeter shared with the scheduler — the
+        #: admission-control signal (None: never shed)
+        self.burn_meter = burn_meter
         self.tier_counts: Dict[str, int] = {t: 0 for t in TIERS}
         #: rungs that raised (device OOM, failed batch, solver bug) instead
         #: of answering — each such request still got a greedy tour
         self.rung_failures: Dict[str, int] = {t: 0 for t in TIERS}
         self._count_lock = threading.Lock()
+        #: per-request-thread scratch: the scheduler queue wait of the
+        #: current rung attempt, so the estimator can learn SERVICE time
+        #: (see :meth:`_attempt`)
+        self._tls = threading.local()
+        self._ckpt_dir: Optional[str] = None
+        self._ckpt_dir_owned = False
+        self._job_seq = 0
+
+    def _job_checkpoint_path(self) -> str:
+        """A unique per-job snapshot path under the (lazily created)
+        checkpoint dir — preempted proofs park their donated state here
+        between slices."""
+        with self._count_lock:
+            if self._ckpt_dir is None:
+                self._ckpt_dir = self.cfg.bnb_checkpoint_dir
+                if self._ckpt_dir is None:
+                    self._ckpt_dir = tempfile.mkdtemp(prefix="tsp-serve-bnb-")
+                    self._ckpt_dir_owned = True
+                else:
+                    os.makedirs(self._ckpt_dir, exist_ok=True)
+            self._job_seq += 1
+            seq = self._job_seq
+        return os.path.join(self._ckpt_dir, f"job-{os.getpid()}-{seq}")
+
+    def cleanup(self) -> None:
+        """Best-effort removal of the auto-created checkpoint dir (the
+        service calls this from ``close``; an explicitly configured
+        ``bnb_checkpoint_dir`` is the operator's to manage)."""
+        import shutil
+
+        with self._count_lock:
+            path, owned = self._ckpt_dir, self._ckpt_dir_owned
+            self._ckpt_dir = None
+            self._ckpt_dir_owned = False
+        if path and owned:
+            shutil.rmtree(path, ignore_errors=True)
 
     def _attempt(
         self, tier: str, n: int, run, budget_s: Optional[float] = None
@@ -190,8 +254,20 @@ class DeadlineLadder:
         stale value, or the retry re-runs with time that no longer
         exists). Exhausted retries and real exceptions are counted, not
         propagated: the ladder's contract is that a well-formed instance
-        always gets a tour from SOME rung."""
+        always gets a tour from SOME rung.
+
+        The estimator is fed SERVICE time — elapsed minus the scheduler
+        queue wait the rung's ticket reported (``_tls.queue_wait``).
+        Queueing is transient congestion the iteration-level loop and
+        admission control are responsible for; folding it into the EWMA
+        would let one head-of-line episode (a long proof slicing through)
+        pin every later tight-deadline request to greedy long after the
+        queue drained, because greedy answers never update the pipeline
+        series again. Timeouts keep the full elapsed: a rung that burned
+        its whole budget must still teach the estimator the cost of
+        promising it."""
         t0 = time.monotonic()
+        self._tls.queue_wait = 0.0
 
         def attempt_once():
             _fault_registry().fire("ladder.rung")
@@ -218,8 +294,14 @@ class DeadlineLadder:
                 return None
             finally:
                 elapsed = time.monotonic() - t0
-                self.estimator.observe(tier, n, elapsed)
+                service = max(
+                    elapsed - getattr(self._tls, "queue_wait", 0.0), 0.0
+                )
+                self.estimator.observe(tier, n, service)
                 _REGISTRY.inc("serve_rung_attempts_total", tier=tier)
+                # the wall metric keeps the FULL elapsed (what the
+                # request experienced); only the estimator gets the
+                # queue-corrected service time
                 _REGISTRY.inc(
                     "serve_rung_seconds_total", max(elapsed, 0.0), tier=tier
                 )
@@ -230,6 +312,22 @@ class DeadlineLadder:
         the dicts while request threads increment them (graftflow R9)."""
         with self._count_lock:
             return dict(self.tier_counts), dict(self.rung_failures)
+
+    def _shed(self, tier: str) -> bool:
+        """SLO-burn admission control: True when ``tier``'s live error
+        budget is burning past ``cfg.slo_shed_burn`` — the request is
+        degraded to the next rung DOWN and the shed is accounted
+        (``serve_flushes_total{cause=slo_shed}``). Shedding new
+        admissions is what lets the burning tier's existing queue drain
+        back inside its objective."""
+        bm = self.burn_meter
+        if bm is None:
+            return False
+        b = bm.burn(tier)
+        if b is None or b <= self.cfg.slo_shed_burn:
+            return False
+        self.scheduler.note_shed(tier)
+        return True
 
     def upgrade_eligible(
         self, n: int, deadline_s: float, entry_tier: str, certified_gap
@@ -260,9 +358,7 @@ class DeadlineLadder:
 
     # -- rung implementations ------------------------------------------------
 
-    def _run_bnb(self, d: np.ndarray, budget_s: float) -> LadderResult:
-        solver = self.cfg.bnb_solver or _default_bnb_solver(self.cfg)
-        cost, tour, lb, proven = solver(d, budget_s * self.cfg.bnb_budget_fraction)
+    def _bnb_result(self, cost, tour, lb, proven: bool) -> LadderResult:
         if proven or cost <= lb:
             gap = 0.0
         else:
@@ -273,6 +369,51 @@ class DeadlineLadder:
             tier="bnb",
             certified_gap=gap,
             lower_bound=float(lb),
+        )
+
+    def _run_bnb(self, d: np.ndarray, budget_s: float) -> Optional[LadderResult]:
+        """The certified rung. An injected ``bnb_solver`` runs inline
+        (tests pin that call shape); the default rung rides the
+        scheduler's iteration-level loop in ``bnb_slice_s`` chunks, so a
+        long proof yields the device between slices instead of
+        monopolizing it. Returns None when the wait outlives the budget
+        (the caller degrades; the job keeps slicing until ITS deadline
+        and is simply discarded — its partial evidence still teaches the
+        estimator)."""
+        limit = budget_s * self.cfg.bnb_budget_fraction
+        solver = self.cfg.bnb_solver
+        if solver is not None:
+            cost, tour, lb, proven = solver(d, limit)
+            return self._bnb_result(cost, tour, lb, proven)
+        job = self.scheduler.submit_bnb(
+            d,
+            budget_s=max(limit, 0.05),
+            slice_s=self.cfg.bnb_slice_s,
+            checkpoint_path=self._job_checkpoint_path(),
+            solve_kw=dict(
+                capacity=self.cfg.bnb_capacity,
+                k=self.cfg.bnb_k,
+                device_loop=False,  # fine-grained time-limit checks
+            ),
+        )
+        res = job.wait(timeout=max(budget_s, 1e-3))
+        n = d.shape[0]
+        handle = job.handle
+        if res is not None and not res.proven_optimal and handle is not None:
+            # the rung finished UNPROVEN at its deadline (after >= 1
+            # preemption): project the full proof cost from the partial
+            # gap closure so tier selection stops over-promising bnb
+            self.estimator.observe_partial(
+                "bnb", n, handle.elapsed_s, handle.gap_progress()
+            )
+        if res is None:
+            if handle is not None:
+                self.estimator.observe_partial(
+                    "bnb", n, handle.elapsed_s, handle.gap_progress()
+                )
+            return None
+        return self._bnb_result(
+            res.cost, res.tour, res.lower_bound, bool(res.proven_optimal)
         )
 
     def _run_pipeline(
@@ -292,6 +433,7 @@ class DeadlineLadder:
                 if got is None:
                     sp.set("outcome", "timeout")
                     return None
+            self._tls.queue_wait = ticket.queue_age_s or 0.0
             costs, tours = got
             return LadderResult(
                 cost=float(costs[0]),
@@ -332,6 +474,7 @@ class DeadlineLadder:
                 if got is None:
                     sp.set("outcome", "timeout")
                     return None
+            self._tls.queue_wait = ticket.queue_age_s or 0.0
             costs, tours = got
             # fold in global (request-space) ids via the resident matrix
             global_tours = np.asarray(blocks)[
@@ -376,6 +519,7 @@ class DeadlineLadder:
                 n <= cfg.bnb_max_n
                 and rem >= cfg.bnb_min_budget_s
                 and rem >= est.estimate("bnb", n, cfg.prior_s["bnb"])
+                and not self._shed("bnb")
             ):
                 # budget() is re-read INSIDE the lambda: a retry after a
                 # late transient fault must run with the time actually
@@ -386,7 +530,9 @@ class DeadlineLadder:
                     lambda: self._run_bnb(d, max(budget(), 0.05)),
                     budget_s=rem,
                 )
-            elif budget() >= est.estimate("pipeline", n, cfg.prior_s["pipeline"]):
+            elif budget() >= est.estimate(
+                "pipeline", n, cfg.prior_s["pipeline"]
+            ) and not self._shed("pipeline"):
                 result = self._attempt(
                     "pipeline", n,
                     lambda: self._run_pipeline(xy, d, budget()),

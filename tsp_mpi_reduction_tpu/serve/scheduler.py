@@ -1,18 +1,43 @@
-"""Async micro-batching scheduler: N pending solves -> one padded vmap call.
+"""Iteration-level micro-batch scheduler: one device loop, preemptible units.
 
-The ORCA/Clipper idea (PAPERS.md) applied to the repo's exact block solver:
-request threads :meth:`~MicroBatchScheduler.submit` ``[B, n, n]`` block
-distance stacks and park on a ticket; a single worker thread drains the
-queue, groups pending submissions of the SAME block size ``n`` (oldest
-first — mixed shapes are served in arrival order, never starved), pads the
-concatenated batch up to a compile bucket, and runs ONE
-``solve_blocks_from_dists`` device call for the whole group instead of one
-dispatch per request.
+The ORCA idea (OSDI '22, PAPERS.md) applied to the repo's exact solvers:
+the worker thread runs a device LOOP whose unit of scheduling is one
+bounded device interaction — a padded Held-Karp vmap flush, or one
+time-sliced chunk of a B&B proof — never a whole request. Between units
+the loop re-reads the queues, so newly arrived work is admitted and
+finished work retired at iteration granularity instead of cohort
+granularity.
 
-Latency discipline (the "max-wait knob"): the worker flushes as soon as
+Two lanes feed the loop:
+
+- **HK tickets** (:meth:`~MicroBatchScheduler.submit`): ``[B, n, n]``
+  block distance stacks; same-shape groups are concatenated, padded up to
+  a compile bucket, and solved in ONE ``solve_blocks_from_dists`` call.
+- **B&B jobs** (:meth:`~MicroBatchScheduler.submit_bnb`): certified
+  branch & bound proofs, run ``slice_s`` seconds at a time through
+  ``models.branch_bound.solve_slice``. A slice that ends unproven with
+  budget remaining is **preempted**: the search state persists through
+  the crash-safe donated checkpoint path (``branch_bound.save`` /
+  ``restore``) and the job re-queues behind its peers (round-robin
+  fairness), to be **resumed bit-identically** later — a long proof can
+  no longer monopolize the device (BENCH_SERVE's all-greedy tight-
+  deadline failure mode).
+
+Latency discipline (the "max-wait knob"): an HK group flushes as soon as
 ``max_batch`` blocks are pending, and otherwise no later than
 ``max_wait_ms`` after the OLDEST pending submission arrived — batching can
-add at most ``max_wait_ms`` to any request, never unbounded queueing delay.
+add at most ``max_wait_ms`` to any request, never unbounded queueing
+delay. While B&B work holds the device, pending HK tickets flush into the
+next gap immediately (cause ``admit``) rather than sitting out the knob.
+
+Admission signal: an optional ``obs.slo.BurnMeter`` feeds the loop's
+tie-break — when the ``bnb`` tier's error budget burns hot, a ready B&B
+slice takes priority over a not-yet-due HK flush (alternation still
+bounds either lane's wait to one unit); the ladder uses the same meter to
+shed/degrade NEW admissions (:meth:`~MicroBatchScheduler.note_shed`).
+Every flush/preempt/shed lands in ``serve_flushes_total{cause=}`` and
+every flushed ticket's queue wait in ``serve_queue_age_seconds``, so the
+loop's scheduling decisions are diagnosable after the fact.
 
 Compile discipline: batch sizes are padded up to fixed power-of-two
 ``buckets`` (pad lanes replicate the first real block; vmap lanes are
@@ -64,8 +89,8 @@ class Ticket:
     (nor vice versa)."""
 
     __slots__ = (
-        "dists", "arrived", "ctx", "_event", "_costs", "_tours", "_error",
-        "_claim", "_done",
+        "dists", "arrived", "ctx", "queue_age_s", "_event", "_costs",
+        "_tours", "_error", "_claim", "_done",
     )
 
     def __init__(self, dists: np.ndarray) -> None:
@@ -75,6 +100,10 @@ class Ticket:
         #: request waited on lands in that request's own trace
         self.ctx = _tracing.current_context()
         self.arrived = time.monotonic()
+        #: queue wait stamped when the worker takes the ticket into a
+        #: flush — lets the ladder's latency estimator learn SERVICE time
+        #: (queueing is transient congestion, not a property of the rung)
+        self.queue_age_s: Optional[float] = None
         self._event = threading.Event()
         self._costs: Optional[np.ndarray] = None
         self._tours: Optional[np.ndarray] = None
@@ -113,6 +142,79 @@ class Ticket:
         return self._costs, self._tours
 
 
+class BnBJob:
+    """One step-sliced B&B proof owned by the scheduler's device loop.
+
+    Request threads block on :meth:`wait` exactly like a :class:`Ticket`;
+    the worker runs the proof ``slice_s`` seconds at a time and re-queues
+    the job between slices (``handle`` carries the checkpoint-backed
+    continuation). Outcomes are first-writer-wins for the same reason as
+    tickets: after a watchdog revive, an abandoned worker can race its
+    successor on the same job."""
+
+    __slots__ = (
+        "dists", "solve_kw", "slice_s", "deadline", "checkpoint_path",
+        "arrived", "ctx", "handle", "last_result", "preemptions", "resumes",
+        "first_pickup", "_event", "_result", "_error", "_claim", "_done",
+    )
+
+    def __init__(
+        self,
+        dists: np.ndarray,
+        slice_s: float,
+        budget_s: float,
+        checkpoint_path: str,
+        solve_kw: Optional[Dict] = None,
+    ) -> None:
+        self.dists = dists
+        self.solve_kw = dict(solve_kw or {})
+        self.slice_s = slice_s
+        self.deadline = time.monotonic() + budget_s
+        self.checkpoint_path = checkpoint_path
+        self.arrived = time.monotonic()
+        self.ctx = _tracing.current_context()
+        self.handle = None  #: branch_bound.ResumeHandle between slices
+        self.last_result = None  #: best-so-far BnBResult (deadline answer)
+        self.preemptions = 0
+        self.resumes = 0
+        self.first_pickup: Optional[float] = None
+        self._event = threading.Event()
+        self._result = None
+        self._error: Optional[BaseException] = None
+        self._claim = threading.Lock()
+        self._done = False
+
+    def _take_outcome(self) -> bool:
+        with self._claim:
+            if self._done:
+                return False
+            self._done = True
+            return True
+
+    def _resolve(self, result) -> None:
+        if not self._take_outcome():
+            return
+        self._result = result
+        self._event.set()
+
+    def _fail(self, exc: BaseException) -> None:
+        if not self._take_outcome():
+            return
+        self._error = exc
+        self._event.set()
+
+    def wait(self, timeout: Optional[float] = None):
+        """Block until the proof finishes or its budget lapses. Returns
+        the final ``BnBResult`` (proven, or best-so-far with its certified
+        bound at the deadline), raises the worker's exception on failure,
+        or returns ``None`` on timeout (the caller degrades)."""
+        if not self._event.wait(timeout):
+            return None
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
 class MicroBatchScheduler:
     def __init__(
         self,
@@ -123,6 +225,7 @@ class MicroBatchScheduler:
         timer: Optional[PhaseTimer] = None,
         watchdog_interval_s: float = 0.2,
         stuck_timeout_s: float = 30.0,
+        burn_meter=None,
     ) -> None:
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
@@ -133,8 +236,20 @@ class MicroBatchScheduler:
         self.timer = timer or PhaseTimer()
         self.watchdog_interval_s = watchdog_interval_s
         self.stuck_timeout_s = stuck_timeout_s
+        #: optional obs.slo.BurnMeter — the live admission-control signal
+        self.burn_meter = burn_meter
         self._cv = threading.Condition()
         self._queue: Deque[Ticket] = deque()
+        #: round-robin queue of step-sliced B&B proofs (fairness: one
+        #: slice each, preempted jobs go to the back)
+        self._bnb_queue: Deque[BnBJob] = deque()
+        #: the job the worker is slicing right now — what the watchdog
+        #: re-queues alongside ``_inflight`` when that worker dies (the
+        #: slice re-runs from the last donated checkpoint: crash-safe)
+        self._inflight_bnb: Optional[BnBJob] = None
+        #: alternation guard: a burning bnb tier may take priority over a
+        #: ready HK flush, but never twice in a row (neither lane starves)
+        self._last_was_bnb = False
         self._thread: Optional[threading.Thread] = None
         self._watchdog: Optional[threading.Thread] = None
         self._stop = False
@@ -165,6 +280,13 @@ class MicroBatchScheduler:
         self.stuck_restarts = 0  #: wedged workers abandoned + replaced
         self.precompiled_buckets = 0  #: shape buckets warmed by precompile()
         self.precompile_seconds = 0.0  #: wall spent in precompile()
+        # -- iteration-level loop counters (ISSUE 13) --
+        self.admit_flushes = 0  #: HK flushes pulled forward into a B&B gap
+        self.bnb_jobs = 0  #: B&B proofs submitted
+        self.bnb_slices = 0  #: device slices run (>= jobs when preempting)
+        self.bnb_preemptions = 0  #: slices preempted with budget remaining
+        self.bnb_resumes = 0  #: preempted proofs resumed from checkpoint
+        self.slo_sheds = 0  #: admissions shed/degraded by the burn signal
 
     # -- warmup --------------------------------------------------------------
 
@@ -237,6 +359,56 @@ class MicroBatchScheduler:
         _REGISTRY.set_gauge("serve_queue_depth_blocks", depth)
         return ticket
 
+    def submit_bnb(
+        self,
+        d: np.ndarray,
+        *,
+        budget_s: float,
+        slice_s: float,
+        checkpoint_path: str,
+        solve_kw: Optional[Dict] = None,
+    ) -> BnBJob:
+        """Enqueue one certified B&B proof on the iteration-level loop.
+
+        ``d``: dense [n, n] distance matrix. ``budget_s``: wall budget
+        from NOW — at the deadline the job resolves with its best-so-far
+        result and certified bound. ``slice_s``: preemption granularity
+        (a slice that ends unproven re-queues behind other work).
+        ``checkpoint_path``: where the between-slice snapshot lives; must
+        be unique per job. ``solve_kw`` forwards to
+        ``models.branch_bound.solve`` (identical across slices).
+        Validation raises here, synchronously, like :meth:`submit`."""
+        d = np.asarray(d)
+        if d.ndim != 2 or d.shape[0] != d.shape[1]:
+            raise ValueError(f"expected [n, n] distance matrix, got {d.shape}")
+        if d.shape[0] < 3:
+            raise ValueError(f"B&B needs n >= 3 cities, got {d.shape[0]}")
+        if not budget_s > 0 or not slice_s > 0:
+            raise ValueError(
+                f"budget_s and slice_s must be > 0, got {budget_s}, {slice_s}"
+            )
+        if not checkpoint_path:
+            raise ValueError("submit_bnb needs a checkpoint_path")
+        job = BnBJob(d, slice_s, budget_s, checkpoint_path, solve_kw)
+        with self._cv:
+            if self._stop:
+                raise RuntimeError("scheduler is closed")
+            self._ensure_threads_locked()
+            self._bnb_queue.append(job)
+            self.bnb_jobs += 1
+            self._cv.notify_all()
+        return job
+
+    def note_shed(self, tier: str) -> None:
+        """Record one admission shed/degraded by the SLO burn signal (the
+        ladder calls this when it skips a burning tier for a new request
+        — scheduling accounting lives here with the other causes)."""
+        with self._cv:
+            self.slo_sheds += 1
+        _REGISTRY.inc("serve_flushes_total", cause="slo_shed")
+        _REGISTRY.inc("serve_slo_sheds_total", tier=tier)
+        HEALTH.incr("slo_sheds")
+
     def close(self) -> None:
         """Stop the worker + watchdog; pending tickets are failed, not
         dropped (in-flight tickets the worker abandoned included)."""
@@ -258,8 +430,20 @@ class MicroBatchScheduler:
             pending += list(self._queue)
             self._inflight = []
             self._queue.clear()
+            jobs: List[BnBJob] = list(self._bnb_queue)
+            if self._inflight_bnb is not None:
+                jobs.append(self._inflight_bnb)
+            self._bnb_queue.clear()
+            self._inflight_bnb = None
         for t in pending:
             t._fail(RuntimeError("scheduler closed before solve"))
+        for j in jobs:
+            # a job that already ran slices resolves with its best-so-far
+            # certified result — closing mid-proof degrades, never errors
+            if j.last_result is not None:
+                j._resolve(j.last_result)
+            else:
+                j._fail(RuntimeError("scheduler closed before solve"))
         _REGISTRY.set_gauge("serve_queue_depth_blocks", 0)
 
     # -- supervision ---------------------------------------------------------
@@ -299,6 +483,13 @@ class MicroBatchScheduler:
         live = [t for t in self._inflight if not t._event.is_set()]
         self._inflight = []
         self._queue.extendleft(reversed(live))
+        # the slice the dead worker held re-runs from the last donated
+        # checkpoint — deterministic search makes the re-run bit-identical,
+        # so a crash mid-slice costs at most one slice of wall time
+        job = self._inflight_bnb
+        self._inflight_bnb = None
+        if job is not None and not job._event.is_set():
+            self._bnb_queue.appendleft(job)
         if stuck:
             self.stuck_restarts += 1
             HEALTH.incr("stuck_restarts")
@@ -324,10 +515,15 @@ class MicroBatchScheduler:
                 if worker is None:
                     continue
                 if not worker.is_alive():
-                    if self._queue or self._inflight:
+                    if (
+                        self._queue
+                        or self._inflight
+                        or self._bnb_queue
+                        or self._inflight_bnb is not None
+                    ):
                         self._revive_locked(stuck=False)
                 elif (
-                    self._inflight
+                    (self._inflight or self._inflight_bnb is not None)
                     and time.monotonic() - self._heartbeat > self._stuck_allowance
                 ):
                     self._revive_locked(stuck=True)
@@ -340,18 +536,63 @@ class MicroBatchScheduler:
 
     # -- worker --------------------------------------------------------------
 
-    def _collect(self, gen: int) -> Optional[List[Ticket]]:
-        """Under the condition lock: wait for a flushable group and pop it.
+    def _prefer_bnb_locked(self) -> bool:
+        """Under ``self._cv``: should a ready B&B slice jump a ready HK
+        flush? Only when the burn meter says the bnb tier is eating its
+        error budget faster than allowed AND faster than pipeline, and
+        the PREVIOUS unit wasn't bnb (alternation bounds the HK lane's
+        extra wait to one slice)."""
+        if self.burn_meter is None or self._last_was_bnb:
+            return False
+        b = self.burn_meter.burn("bnb")
+        if b is None or b <= 1.0:
+            return False
+        return b > (self.burn_meter.burn("pipeline") or 0.0)
 
-        Returns the oldest submission plus every later pending ticket of
-        the same block size, up to ``max_batch`` total blocks; None when
-        shutting down with an empty queue, or when this worker's
-        generation has been superseded by the watchdog (stand down)."""
+    def _take_hk_locked(self, n: int, cause: str):
+        """Under ``self._cv``: count the flush cause, pop the same-shape
+        group, mark it in flight, and record each ticket's queue age."""
+        if cause == "full":
+            self.full_flushes += 1
+        elif cause == "admit":
+            self.admit_flushes += 1
+        else:
+            self.wait_flushes += 1
+        _REGISTRY.inc("serve_flushes_total", cause=cause)
+        group = self._pop_group(n)
+        self._inflight = list(group)
+        self._last_was_bnb = False
+        now = time.monotonic()
+        for t in group:
+            t.queue_age_s = now - t.arrived
+            _REGISTRY.observe("serve_queue_age_seconds", t.queue_age_s)
+        return ("hk", group)
+
+    def _collect(self, gen: int):
+        """Under the condition lock: wait for the next schedulable unit.
+
+        Returns ``("hk", [Ticket, ...])`` — the oldest submission plus
+        every later pending ticket of the same block size, up to
+        ``max_batch`` total blocks — or ``("bnb", BnBJob)`` — the next
+        proof slice in round-robin order; None when shutting down with
+        empty queues, or when this worker's generation has been
+        superseded by the watchdog (stand down).
+
+        An HK group is due on the classic conditions (full / max-wait /
+        shutdown) and ADDITIONALLY whenever B&B work holds the loop —
+        waiting out the knob while proof slices own the device would add
+        a slice of latency for nothing (cause ``admit``). A due group
+        normally goes first (HK units are the latency-sensitive lane);
+        a burning bnb tier may take one slice of priority
+        (:meth:`_prefer_bnb_locked`)."""
         with self._cv:
             while True:
                 if self._gen != gen:
                     return None
                 self._heartbeat = time.monotonic()
+                bnb_pending = bool(self._bnb_queue)
+                hk_cause = None
+                waited = 0.0
                 if self._queue:
                     head = self._queue[0]
                     pending = sum(
@@ -360,21 +601,30 @@ class MicroBatchScheduler:
                         if t.dists.shape[1] == head.dists.shape[1]
                     )
                     waited = time.monotonic() - head.arrived
-                    if self._stop or pending >= self.max_batch or waited >= self.max_wait_s:
-                        if pending >= self.max_batch:
-                            self.full_flushes += 1
-                            _REGISTRY.inc("serve_flushes_total", cause="full")
-                        else:
-                            self.wait_flushes += 1
-                            _REGISTRY.inc("serve_flushes_total", cause="wait")
-                        group = self._pop_group(head.dists.shape[1])
-                        self._inflight = list(group)
-                        return group
+                    if pending >= self.max_batch:
+                        hk_cause = "full"
+                    elif self._stop or waited >= self.max_wait_s:
+                        hk_cause = "wait"
+                    elif bnb_pending:
+                        hk_cause = "admit"
+                if bnb_pending and (
+                    hk_cause is None or self._prefer_bnb_locked()
+                ):
+                    job = self._bnb_queue.popleft()
+                    self._inflight_bnb = job
+                    self._last_was_bnb = True
+                    self.bnb_slices += 1
+                    return ("bnb", job)
+                if hk_cause is not None:
+                    return self._take_hk_locked(
+                        self._queue[0].dists.shape[1], hk_cause
+                    )
+                if self._stop:
+                    return None
+                if self._queue:
                     # batch still filling: sleep until the oldest request's
                     # wait budget lapses (or a new submission wakes us)
                     self._cv.wait(self.max_wait_s - waited)
-                elif self._stop:
-                    return None
                 else:
                     self._cv.wait()
 
@@ -404,16 +654,103 @@ class MicroBatchScheduler:
 
     def _worker(self, gen: int) -> None:
         while True:
-            group = self._collect(gen)
-            if group is None:
+            work = self._collect(gen)
+            if work is None:
                 return
-            self._run_batch(group)
+            kind, item = work
+            if kind == "hk":
+                self._run_batch(item)
+            else:
+                self._run_bnb_slice(item, gen)
             with self._cv:
                 if self._gen == gen:
-                    self._inflight = []
-                    # a clean batch proves the worker healthy: restore
+                    if kind == "hk":
+                        self._inflight = []
+                    elif self._inflight_bnb is item:
+                        self._inflight_bnb = None
+                    # a clean unit proves the worker healthy: restore
                     # the base stuck threshold for future batches
                     self._stuck_allowance = self.stuck_timeout_s
+
+    def _run_bnb_slice(self, job: BnBJob, gen: int) -> None:
+        """One preemptible slice of a certified proof, outside the lock.
+
+        Runs at most ``job.slice_s`` seconds of ``branch_bound.solve``
+        through the donated-checkpoint continuation (``solve_slice``). A
+        slice that PROVES optimality (or exhausts the job's budget)
+        resolves the job with its final/best-so-far certified result; a
+        slice that ends unproven with budget remaining is a PREEMPTION —
+        the job re-queues at the back (round-robin fairness) and its next
+        pickup is a RESUME. Every outcome lands in the counters, the
+        ``serve_flushes_total{cause=preempt}`` series, the ``HEALTH``
+        block, and a ``bnb.slice`` span parented to the submitting
+        request's trace."""
+        from ..models.branch_bound import solve_slice
+
+        now = time.monotonic()
+        if job.first_pickup is None:
+            job.first_pickup = now
+            _REGISTRY.observe("serve_queue_age_seconds", now - job.arrived)
+        remaining = job.deadline - now
+        if remaining <= 0 and job.last_result is not None:
+            # budget lapsed while queued: answer with the certified
+            # best-so-far rather than spending device time past deadline
+            job._resolve(job.last_result)
+            return
+        slice_s = min(job.slice_s, max(remaining, 0.05))
+        resumed = job.handle is not None
+        if resumed:
+            job.resumes += 1
+            with self._cv:
+                self.bnb_resumes += 1
+            _REGISTRY.inc("serve_bnb_resumes_total")
+            HEALTH.incr("bnb_resumes")
+        t0, ts0 = time.perf_counter(), time.time()
+        error: Optional[str] = None
+        preempted = proven = False
+        try:
+            res, handle = solve_slice(
+                job.dists, slice_s, job.handle,
+                checkpoint_path=job.checkpoint_path, **job.solve_kw,
+            )
+            job.last_result = res
+            job.handle = handle
+            proven = handle is None
+            if proven or job.deadline - time.monotonic() <= 0:
+                job._resolve(res)
+            else:
+                preempted = True
+                job.preemptions += 1
+                with self._cv:
+                    self.bnb_preemptions += 1
+                    if self._gen == gen and not self._stop:
+                        self._bnb_queue.append(job)
+                        self._cv.notify_all()
+                _REGISTRY.inc("serve_flushes_total", cause="preempt")
+                _REGISTRY.inc("serve_bnb_preemptions_total")
+                HEALTH.incr("bnb_preemptions")
+        except BaseException as exc:  # noqa: BLE001 — jobs must not hang
+            error = f"{type(exc).__name__}: {exc}"
+            job._fail(exc)
+        finally:
+            events = _tracing.drain_pending()
+            if _tracing.TRACER.active:
+                attrs = {
+                    "slice_s": round(slice_s, 4),
+                    "resumed": resumed,
+                    "preempted": preempted,
+                    "proven": proven,
+                    "slices": job.resumes + 1,
+                }
+                if job.last_result is not None:
+                    attrs["incumbent"] = float(job.last_result.cost)
+                    attrs["lower_bound"] = float(job.last_result.lower_bound)
+                if error is not None:
+                    attrs["error"] = error
+                _tracing.emit_span(
+                    "bnb.slice", job.ctx, ts0,
+                    time.perf_counter() - t0, attrs, events,
+                )
 
     def _bucket(self, total: int) -> int:
         for b in self.buckets:
@@ -548,6 +885,12 @@ class MicroBatchScheduler:
                 "queue_depth_hwm": self.queue_depth_hwm,
                 "full_flushes": self.full_flushes,
                 "wait_flushes": self.wait_flushes,
+                "admit_flushes": self.admit_flushes,
+                "bnb_jobs": self.bnb_jobs,
+                "bnb_slices": self.bnb_slices,
+                "bnb_preemptions": self.bnb_preemptions,
+                "bnb_resumes": self.bnb_resumes,
+                "slo_sheds": self.slo_sheds,
                 "worker_restarts": self.worker_restarts,
                 "stuck_restarts": self.stuck_restarts,
                 "precompiled_buckets": self.precompiled_buckets,

@@ -44,6 +44,7 @@ from typing import Dict, IO, Iterable, List, Optional
 
 import numpy as np
 
+from ..obs import metrics as _metrics
 from ..obs import slo as _slo
 from ..obs import tracing as _tracing
 from ..obs.metrics import REGISTRY as _REGISTRY
@@ -114,6 +115,11 @@ class SolveService:
             )
         else:
             self.cache = SolutionCache(self.cfg.cache_capacity)
+        #: the LIVE admission-control signal (ISSUE 13): windowed per-tier
+        #: error-budget burn over recent answers, shared by the scheduler
+        #: (burning-tier priority) and the ladder (shed/degrade new
+        #: admissions) — not static queue depth
+        self.burn = _slo.BurnMeter(self.cfg.slos)
         self.scheduler = MicroBatchScheduler(
             max_batch=self.cfg.max_batch,
             max_wait_ms=self.cfg.max_wait_ms,
@@ -121,8 +127,11 @@ class SolveService:
             timer=self.timer,
             watchdog_interval_s=self.cfg.watchdog_interval_s,
             stuck_timeout_s=self.cfg.stuck_timeout_s,
+            burn_meter=self.burn,
         )
-        self.ladder = DeadlineLadder(self.scheduler, self.cfg.ladder)
+        self.ladder = DeadlineLadder(
+            self.scheduler, self.cfg.ladder, burn_meter=self.burn
+        )
         #: canonicalization memo: skips the per-request lexsort for
         #: byte-identical (post-quantization) resubmissions — the trimmed
         #: host path around the frozen kernel (see canonical.CanonicalCache)
@@ -137,6 +146,9 @@ class SolveService:
         #: (same delta discipline as health — a prior session's misses
         #: must not burn this session's error budget)
         self._latency0 = _REGISTRY.snapshot(prefix="serve_request_seconds")
+        #: queue-age baseline (same delta discipline): the admission block
+        #: reports THIS session's wait-time percentiles
+        self._queue_age0 = _REGISTRY.snapshot(prefix="serve_queue_age_seconds")
         self.responses = 0
         self.errors = 0
         self.deadline_misses = 0
@@ -269,6 +281,9 @@ class SolveService:
         _REGISTRY.observe(
             "serve_request_seconds", latency_ms / 1000.0, tier=tier
         )
+        # feed the LIVE burn meter: this answer immediately moves the
+        # admission/priority signal the scheduler and ladder read
+        self.burn.observe(tier, latency_ms / 1000.0)
         with _tracing.span("respond"):
             return {
                 "id": req_id,
@@ -311,6 +326,32 @@ class SolveService:
             if isinstance(v, dict)
         }
         slo_block = _slo.evaluate(hists_by_tier, self.cfg.slos)
+        sched_stats = self.scheduler.stats()
+        # admission/preemption block (ISSUE 13): the live burn signal the
+        # scheduler steered by, what it cost (sheds, preemptions), and how
+        # long work actually queued — the continuous-batching story in one
+        # place for tools/obs_report.py --serve
+        qage = _REGISTRY.delta(self._queue_age0, prefix="serve_queue_age_seconds")
+        qage_hist = None
+        for v in qage.data.get(
+            "serve_queue_age_seconds", {}
+        ).get("series", {}).values():
+            if isinstance(v, dict):
+                qage_hist = v
+                break
+        admission = {
+            "burn": self.burn.snapshot(),
+            "slo_sheds": sched_stats.get("slo_sheds", 0),
+            "preemptions": sched_stats.get("bnb_preemptions", 0),
+            "resumes": sched_stats.get("bnb_resumes", 0),
+            "admit_flushes": sched_stats.get("admit_flushes", 0),
+            "queue_age_s": {
+                "count": int(qage_hist.get("count", 0)) if qage_hist else 0,
+                "p50": _metrics.hist_quantile(qage_hist, 0.50) if qage_hist else None,
+                "p90": _metrics.hist_quantile(qage_hist, 0.90) if qage_hist else None,
+                "p99": _metrics.hist_quantile(qage_hist, 0.99) if qage_hist else None,
+            },
+        }
         return reporting.service_stats_json(
             responses=responses,
             errors=errors,
@@ -319,7 +360,8 @@ class SolveService:
             rung_failures=rung_failures,
             tier_counts=tier_counts,
             cache=cache_stats,
-            scheduler=self.scheduler.stats(),
+            scheduler=sched_stats,
+            admission=admission,
             phases_s=self.timer.snapshot(),
             # THIS session's recoveries, not the process's lifetime count
             # (registry-backed delta; see resilience.health)
@@ -334,6 +376,9 @@ class SolveService:
 
     def close(self) -> None:
         self.scheduler.close()
+        # drop the per-session B&B checkpoint directory (preempted-slice
+        # snapshots are worthless once their jobs are resolved)
+        self.ladder.cleanup()
 
     def __enter__(self) -> "SolveService":
         return self

@@ -114,6 +114,7 @@ def service_stats_json(
     compile_cache: Optional[Dict] = None,
     slo: Optional[Dict] = None,
     obs: Optional[Dict] = None,
+    admission: Optional[Dict] = None,
 ) -> str:
     """Machine-readable serve-layer counters (SpillStats-style): per-tier
     answer counts, cache hit/miss/eviction totals plus the derived hit
@@ -141,6 +142,10 @@ def service_stats_json(
         # per-tier latency SLO verdicts (obs.slo): session-window
         # attainment vs each tier's objective + error-budget burn rate
         "slo": slo or {},
+        # iteration-level admission control (ISSUE 13): live per-tier
+        # burn-rate snapshot, sheds/preemptions/resumes it caused, and
+        # queue-age percentiles (how long work waited for a device gap)
+        "admission": admission or {},
         "obs": obs or {},
     }
     return json.dumps(payload)
