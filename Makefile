@@ -14,7 +14,7 @@ PY ?= python
 CXX ?= g++
 CXXFLAGS ?= -O2 -std=c++11
 
-.PHONY: all lint chaos native oracle test test-fast bench bench-serve bench-faults bench-compile bench-obs bench-step bench-shard bench-fleet bench-check run sweep goldens clean
+.PHONY: all lint chaos native oracle test test-fast bench bench-serve bench-faults bench-compile bench-obs bench-step bench-shard bench-balance bench-fleet bench-check run sweep goldens clean
 
 all: lint native oracle chaos bench-check
 
@@ -109,6 +109,16 @@ bench-obs:
 # -> BENCH_SHARD_OBS.json
 bench-shard:
 	TSP_BENCH=shard $(PY) bench.py
+
+# adaptive load-balance bench (ISSUE 15): static ring vs adaptive
+# controller on the skewed 4-rank config (>= 5x imbalance reduction at
+# equal-or-better wall, same proven optimum), plus the balanced-mesh
+# zero-dispatch control -> BENCH_BALANCE.json; chained into bench-check
+# via the governed shard_balance_imbalance / shard_steal_bytes_per_node
+# series
+bench-balance:
+	TSP_BENCH=balance $(PY) bench.py
+	$(MAKE) bench-check
 
 # fleet serving bench (ISSUE 11): sustained RPS + p99 vs replica count
 # 1/2/4 (clean, then under injected replica.kill), plus the chaos

@@ -1,0 +1,373 @@
+"""Adaptive load balance (ISSUE 15): assignment math, multiset safety,
+controller policy, zero-dispatch regimes, no-retrace across solves.
+
+Layered like the module under test: the pure assignment plans are fuzzed
+mesh-free (conservation/partition are properties of the math alone), the
+shard-local collective steps are property-tested on a real 4-rank mesh
+with unique row payloads (the global multiset of live rows must survive
+ANY action under ANY skew), and the controller's policy (dead-band, worth
+floor, escalation, hysteresis, forced skip) is pinned host-side before
+the end-to-end solve tests exercise the whole closed loop.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from tsp_mpi_reduction_tpu.analysis.contracts import RecompilationGuard
+from tsp_mpi_reduction_tpu.models import branch_bound as bb
+from tsp_mpi_reduction_tpu.ops.held_karp import solve_blocks_from_dists
+from tsp_mpi_reduction_tpu.parallel import balance as bal
+from tsp_mpi_reduction_tpu.parallel.mesh import RANK_AXIS, make_rank_mesh
+from tsp_mpi_reduction_tpu.utils.backend import shard_map
+
+
+def random_d(n, seed):
+    xy = np.random.default_rng(seed).uniform(0, 100, (n, 2))
+    return np.rint(np.sqrt(((xy[:, None] - xy[None]) ** 2).sum(-1)) * 10)
+
+
+def symmetric_d(n_ring=12):
+    """Vertex-transitive ring + center city: every rank's root subtrees
+    are equivalent under round-robin dealing, so occupancy STAYS balanced
+    for the whole solve — the only honest zero-dispatch control (a random
+    instance de-balances structurally mid-solve no matter how the roots
+    are dealt)."""
+    th = np.linspace(0, 2 * np.pi, n_ring, endpoint=False)
+    xy = np.concatenate(
+        [np.stack([50 + 40 * np.cos(th), 50 + 40 * np.sin(th)], 1),
+         [[50.0, 50.0]]]
+    )
+    return np.rint(np.sqrt(((xy[:, None] - xy[None]) ** 2).sum(-1)) * 10)
+
+
+# -- pure assignment math ------------------------------------------------------
+
+
+def test_steal_assignment_partitions_the_pool():
+    """Donor and receiver intervals must each partition [0, moved) exactly
+    — conservation by construction, robust to zero-width donors — and the
+    plan must never overfill a receiver past the mean."""
+    rng = np.random.default_rng(7)
+    cases = [
+        np.array([200, 0, 0, 0]),            # total starvation
+        np.array([100, 50, 50, 0]),          # zero-width middle donors
+        np.array([60, 60, 60, 60]),          # balanced: nothing moves
+        np.array([0, 0, 0, 0]),              # drained
+        np.array([1, 0, 0, 0]),              # sub-slab surplus
+        np.array([5, 200, 7, 200, 0, 3, 0, 190]),  # 8 ranks, mixed
+    ]
+    for _ in range(40):
+        r = int(rng.integers(2, 9))
+        cases.append(rng.integers(0, 240, r))
+    for counts in cases:
+        counts = counts.astype(np.int32)
+        cap = 256
+        for t_slots in (1, 4, 16, 64):
+            m_out, m_in, pool_off, take_off = (
+                np.asarray(x, np.int64)
+                for x in bal.steal_assignment(jnp.asarray(counts), t_slots)
+            )
+            moved = m_out.sum()
+            assert moved == m_in.sum()  # conservation
+            assert (m_out >= 0).all() and (m_out <= t_slots).all()
+            assert (m_in >= 0).all() and (m_in <= t_slots).all()
+            # no rank is both donor and receiver
+            assert (m_out * m_in == 0).all()
+            # donor/receiver intervals each partition [0, moved)
+            for off, width in ((pool_off, m_out), (take_off, m_in)):
+                lanes = [
+                    p
+                    for o, w in zip(off, width)
+                    for p in range(int(o), int(o + w))
+                ]
+                assert sorted(lanes) == list(range(int(moved)))
+            # post-plan occupancy stays within [0, capacity]
+            after = counts - m_out + m_in
+            assert (after >= 0).all() and (after <= cap).all()
+            mean = counts.sum() // len(counts)
+            assert (after[m_in > 0] <= mean).all()
+            assert (after[m_out > 0] >= mean).all()
+
+
+def _run_action(action, mesh, nodes, counts, round_i, *, t_slots, capacity,
+                phys_rows):
+    """One balance collective on a real mesh, via the same apply() the
+    solver's per-action shard_map bodies call."""
+    num_ranks = mesh.devices.size
+    perm_fwd = [(r, (r + 1) % num_ranks) for r in range(num_ranks)]
+    perm_back = [((r + 1) % num_ranks, r) for r in range(num_ranks)]
+
+    def body(nd, c, r):
+        nd2, c2, m = bal.apply(
+            action, nd[0], c[0], r, num_ranks=num_ranks, t_slots=t_slots,
+            capacity=capacity, phys_rows=phys_rows, perm_fwd=perm_fwd,
+            perm_back=perm_back,
+        )
+        return nd2[None], c2[None], m[None]
+
+    fn = jax.jit(shard_map(
+        body, mesh=mesh,
+        in_specs=(P(RANK_AXIS), P(RANK_AXIS), P()),
+        out_specs=(P(RANK_AXIS), P(RANK_AXIS), P(RANK_AXIS)),
+    ))
+    return fn(nodes, counts, round_i)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("action", bal.ACTIONS)
+def test_actions_preserve_live_row_multiset(action):
+    """The satellite's core safety property: EVERY balance action, under
+    ANY skew pattern, preserves the global multiset of live rows — no row
+    duplicated, dropped, or invented — and never overfills a receiver."""
+    R, capacity, t_slots, cols = 4, 32, 8, 5
+    phys_rows = capacity + 4  # dead receive lanes park at phys_rows
+    skews = [
+        [32, 0, 0, 0],
+        [32, 28, 1, 0],
+        [8, 8, 8, 8],
+        [0, 0, 0, 0],
+        [1, 0, 31, 0],
+        [32, 32, 32, 32],
+    ]
+    rng = np.random.default_rng(11)
+    for _ in range(6):
+        skews.append(rng.integers(0, capacity + 1, R).tolist())
+    for counts in skews:
+        counts = np.asarray(counts, np.int32)
+        # unique payload per cell; dead/padding rows carry a sentinel
+        nodes = np.full((R, phys_rows, cols), -7, np.int32)
+        payload = np.arange(R * phys_rows * cols, dtype=np.int32).reshape(
+            R, phys_rows, cols
+        )
+        for r in range(R):
+            nodes[r, : counts[r]] = payload[r, : counts[r]]
+        before = sorted(
+            tuple(row)
+            for r in range(R)
+            for row in nodes[r, : counts[r]].tolist()
+        )
+        for round_i in (0, 1, 3):
+            nd2, c2, m_out = (
+                np.asarray(x)
+                for x in _run_action(
+                    action, make_rank_mesh(R), jnp.asarray(nodes),
+                    jnp.asarray(counts),
+                    jnp.asarray(round_i, jnp.int32),
+                    t_slots=t_slots, capacity=capacity, phys_rows=phys_rows,
+                )
+            )
+            assert (c2 >= 0).all() and (c2 <= capacity).all()
+            assert c2.sum() == counts.sum()  # count conservation
+            after = sorted(
+                tuple(row)
+                for r in range(R)
+                for row in nd2[r, : c2[r]].tolist()
+            )
+            assert after == before, (
+                f"{action} round={round_i} counts={counts.tolist()} "
+                "changed the live-row multiset"
+            )
+            assert (m_out >= 0).all() and (m_out <= t_slots).all()
+            if action == "skip":
+                assert (m_out == 0).all() and (c2 == counts).all()
+
+
+# -- the controller's policy, host-side ----------------------------------------
+
+
+def test_controller_forced_skip_one_rank_and_drained():
+    """The satellite's two zero-dispatch regimes at the decision layer:
+    a 1-rank mesh and a fully drained frontier skip unconditionally, in
+    EVERY mode (adaptive and all three static policies)."""
+    for base, adaptive in (
+        ("ring", False), ("pair", False), ("steal", False), ("pair", True),
+    ):
+        one = bal.BalanceController(
+            num_ranks=1, k=8, t_slots=16, base=base, adaptive=adaptive
+        )
+        for _ in range(3):
+            assert one.decide(np.array([100])) == "skip"
+        multi = bal.BalanceController(
+            num_ranks=4, k=8, t_slots=16, base=base, adaptive=adaptive
+        )
+        for _ in range(3):
+            assert multi.decide(np.zeros(4)) == "skip"  # drained
+
+
+def test_controller_dead_band_and_worth_floor():
+    c = bal.BalanceController(num_ranks=4, k=8, t_slots=16, base="pair")
+    # balanced occupancy: CV under the dead-band
+    assert c.decide(np.array([100, 101, 99, 100])) == "skip"
+    # skewed but nothing worth moving: every rank below k, zero pool
+    assert c.decide(np.array([3, 0, 0, 0])) == "skip"
+    # mild skew above the dead-band with a worthwhile transfer: base action
+    assert c.decide(np.array([100, 100, 30, 2])) in ("pair", "steal")
+    # static mode ignores the dead-band entirely
+    s = bal.BalanceController(
+        num_ranks=4, k=8, t_slots=16, base="ring", adaptive=False
+    )
+    assert s.decide(np.array([100, 101, 99, 100])) == "ring"
+
+
+def test_controller_escalates_on_starvation_and_probe_demotes():
+    starved = np.array([300, 200, 100, 0])
+    # no probe: starvation escalates straight to steal
+    c = bal.BalanceController(num_ranks=4, k=8, t_slots=16, base="pair")
+    assert c.decide(starved) == "steal"
+    # entering steal consults the probe; all-dead donors demote to base
+    c = bal.BalanceController(num_ranks=4, k=8, t_slots=16, base="pair")
+    assert c.decide(starved, alive_probe=lambda: np.zeros(4)) == "pair"
+    # live surplus confirmed: steal stands
+    c = bal.BalanceController(num_ranks=4, k=8, t_slots=16, base="pair")
+    assert c.decide(starved, alive_probe=lambda: starved.copy()) == "steal"
+    assert c.summary()["alive_probes"] == 1
+
+
+def test_controller_probe_throttled_while_steal_stands():
+    """The probe is a collective readback: a STANDING escalation must not
+    re-pay it every round — entry plus every probe_every-th steal round."""
+    starved = np.array([300, 200, 100, 0])
+    c = bal.BalanceController(
+        num_ranks=4, k=8, t_slots=16, base="pair", probe_every=16
+    )
+    calls = []
+
+    def probe():
+        calls.append(1)
+        return starved.copy()
+
+    for _ in range(16):
+        assert c.decide(starved, alive_probe=probe) == "steal"
+    assert len(calls) == 1  # entry only
+    assert c.decide(starved, alive_probe=probe) == "steal"
+    assert len(calls) == 2  # the 16th standing round re-checks
+    assert c.summary()["alive_probes"] == 2
+
+
+def test_controller_settle_hysteresis_and_accounting():
+    c = bal.BalanceController(
+        num_ranks=4, k=8, t_slots=16, base="pair", settle=2
+    )
+    skewed = np.array([300, 200, 100, 0])
+    calm = np.array([100, 100, 100, 100])
+    assert c.decide(skewed) == "steal"
+    # first calm decision after an active action: held at base, not skip
+    assert c.decide(calm) == "pair"
+    # second consecutive calm decision: the collective stands down
+    assert c.decide(calm) == "skip"
+    assert c.last_action == "skip"
+    # leaving skip is immediate
+    assert c.decide(skewed) == "steal"
+    c.record(0, "steal", np.array([4, 0, 0, 0]))
+    c.record(1, "skip", np.zeros(4))
+    s = c.summary()
+    assert s["moved_rows_total"] == 4
+    assert s["collective_dispatches"] == 1
+    assert s["actions"] == {"steal": 1, "skip": 1}
+    assert s["switches"] >= 3
+    d = bal.BalanceController(num_ranks=4, k=8, t_slots=16, base="pair")
+    assert d.decide(skewed) == "steal"
+    assert d.degrade() == "pair"  # injected balance.steal fault absorbed
+    assert d.summary()["steal_degraded"] == 1
+
+
+# -- the closed loop, end to end -----------------------------------------------
+
+_SOLVE_KW = dict(
+    capacity_per_rank=256, k=8, inner_steps=1, bound="min-out",
+    mst_prune=False, node_ascent=0, device_loop=False,
+    max_iters=2_000_000,
+)
+
+
+def test_sharded_one_rank_mesh_zero_balance_dispatches():
+    """Regression for the satellite's first zero-dispatch regime: on a
+    1-rank mesh NO balance collective is ever dispatched, in adaptive and
+    static modes alike, and the solve still proves the exact optimum."""
+    d = random_d(11, 3)
+    hk, _ = solve_blocks_from_dists(d[None])
+    mesh = make_rank_mesh(1)
+    for mode in ("adaptive", "ring"):
+        res = bb.solve_sharded(d, mesh, balance=mode, **_SOLVE_KW)
+        assert res.proven_optimal and res.cost == float(hk[0])
+        assert res.balance["collective_dispatches"] == 0
+        assert set(res.balance["actions"]) <= {"skip"}
+        assert res.balance["moved_rows_total"] == 0
+
+
+def test_sharded_balanced_mesh_zero_balance_dispatches():
+    """The acceptance criterion's balanced control: on a rank-symmetric
+    instance the adaptive controller must keep its hands off — zero
+    collectives, with the skip dead-band actually exercised — while the
+    solve still proves."""
+    d = symmetric_d()
+    hk, _ = solve_blocks_from_dists(d[None])
+    res = bb.solve_sharded(
+        d, make_rank_mesh(4), balance="adaptive", seed_mode="round-robin",
+        capacity_per_rank=160, k=4, inner_steps=2, bound="min-out",
+        mst_prune=False, node_ascent=0, device_loop=False, transfer=4,
+        max_iters=2_000_000,
+    )
+    assert res.proven_optimal and res.cost == float(hk[0])
+    assert res.balance["collective_dispatches"] == 0
+    assert res.balance["actions"].get("skip", 0) > 0
+    assert res.balance["moved_rows_total"] == 0
+
+
+@pytest.mark.slow
+def test_sharded_adaptive_rebalances_and_stays_exact():
+    """Adversarial single-rank seeding: the adaptive controller must
+    actually escalate (steal dispatched, rows moved) and the result must
+    be bit-identical to the static ring's proven optimum — balance moves
+    rows, never correctness."""
+    d = random_d(12, 33)
+    hk, _ = solve_blocks_from_dists(d[None])
+    mesh = make_rank_mesh(4)
+    kw = dict(_SOLVE_KW, seed_mode="single-rank")
+    ring = bb.solve_sharded(d, mesh, balance="ring", **kw)
+    ada = bb.solve_sharded(d, mesh, balance="adaptive", **kw)
+    assert ring.proven_optimal and ada.proven_optimal
+    assert ada.cost == ring.cost == float(hk[0])
+    assert ada.lower_bound == ring.lower_bound
+    b = ada.balance
+    assert b["mode"] == "adaptive"
+    assert b["collective_dispatches"] > 0
+    assert b["actions"].get("steal", 0) > 0  # starvation escalated
+    assert b["moved_rows_total"] > 0
+    # bytes accounting is rows x the packed row width (layout-owned)
+    assert b["moved_bytes_total"] % b["moved_rows_total"] == 0
+    assert b["moved_bytes_total"] > b["moved_rows_total"]
+    assert len(b["rows"]) > 0 and b["cv_max"] > 0
+    # static mode shares the accounting path: the ring reports too
+    assert ring.balance["mode"] == "ring"
+    assert ring.balance["collective_dispatches"] > 0
+
+
+@pytest.mark.slow
+def test_sharded_repeat_solve_no_retrace_on_mode_switches():
+    """The acceptance criterion's RecompilationGuard gate: a second
+    same-config adaptive solve — with the controller switching actions
+    mid-run — must reuse the per-action executables from the first solve
+    with ZERO new jit cache entries and the SAME precompiled objects."""
+    d = random_d(12, 33)
+    mesh = make_rank_mesh(4)
+    kw = dict(_SOLVE_KW, seed_mode="single-rank")
+    res1 = bb.solve_sharded(d, mesh, balance="adaptive", **kw)
+    key, entries = next(reversed(bb._SHARD_ENTRIES.items()))
+    aot_before = dict(entries["aot"])
+    jits = dict(entries["jit"])
+    assert set(jits) >= {"skip", "pair", "steal"}  # per-action entries
+    with RecompilationGuard(jits, limit=0):
+        res2 = bb.solve_sharded(d, mesh, balance="adaptive", **kw)
+    assert res2.proven_optimal and res2.cost == res1.cost
+    assert res2.balance["switches"] >= 1  # modes DID switch mid-solve
+    # the entry set is the same object, with the same compiled actions
+    assert next(reversed(bb._SHARD_ENTRIES.items()))[0] == key
+    after = bb._SHARD_ENTRIES[key]["aot"]
+    assert set(after) == set(aot_before)
+    for a, compiled in aot_before.items():
+        assert after[a] is compiled, f"action {a!r} recompiled"

@@ -143,6 +143,58 @@ def test_corrupt_checkpoint_resume_falls_back_and_stays_monotone(tmp_path):
     assert second.lower_bound >= first.lower_bound
 
 
+# -- adaptive balance under a steal-escalation fault ---------------------------
+
+#: (spec, seam) — the balance controller's escalation seam, armed for the
+#: WHOLE campaign (count=0): every steal the controller attempts is
+#: injected and must degrade that round to the base collective.
+BALANCE_SPECS = [("balance.steal:raise,count=0", "balance.steal")]
+
+
+@pytest.mark.parametrize(
+    "spec,seam", BALANCE_SPECS, ids=[s for s, _ in BALANCE_SPECS]
+)
+def test_balance_steal_fault_degrades_and_stays_exact(spec, seam, tmp_path):
+    """A balance.steal fault mid-solve (ISSUE 15 satellite): the sharded
+    campaign runs chunked with adversarial single-rank seeding — the
+    regime that escalates to steal constantly — with the seam armed the
+    whole time. Every escalation degrades to the base diffusion action;
+    the search must still prove the EXACT optimum with a certified LB
+    monotone across chunks, and both the injections (health registry) and
+    the degradations (obs.balance) must be visible."""
+    from tsp_mpi_reduction_tpu.parallel.mesh import make_rank_mesh
+
+    d = np.rint(random_d(12, 33) * 10)
+    hk_cost = float(solve_blocks_from_dists(d[None])[0][0])
+    mesh = make_rank_mesh(4)
+    ckpt = str(tmp_path / "balance.npz")
+    kw = dict(capacity_per_rank=256, k=8, inner_steps=1, bound="min-out",
+              mst_prune=False, node_ascent=0, device_loop=False,
+              seed_mode="single-rank", balance="adaptive")
+    faults.configure(spec)
+    floors = []
+    degraded = 0
+    res = None
+    for _chunk in range(15):
+        resume = ckpt if os.path.exists(ckpt) else None
+        res = bb.solve_sharded(d, mesh, max_iters=300, checkpoint_path=ckpt,
+                               resume_from=resume, **kw)
+        floors.append(res.lower_bound)
+        degraded += res.balance["steal_degraded"]
+        if res.proven_optimal:
+            break
+    assert res is not None and res.proven_optimal
+    assert res.cost == hk_cost  # exact despite every steal being injected
+    assert floors == sorted(floors)  # certified LB monotone across chunks
+    assert faults.registry().hits(seam) > 0, "steal never escalated"
+    assert degraded > 0  # the absorb path actually ran
+    # the fault blocked EVERY steal: none may appear in the action mix
+    assert res.balance["actions"].get("steal", 0) == 0
+    assert res.balance["collective_dispatches"] > 0  # base action stood in
+    health = HEALTH.snapshot()
+    assert health["faults_injected"].get(seam, 0) >= degraded
+
+
 # -- serve loop under service-side faults --------------------------------------
 
 #: (spec, health counter that must move) — one service seam per workload.
@@ -233,6 +285,7 @@ def test_every_registered_seam_is_exercised():
     from test_fleet_chaos import FLEET_CHAOS_SEAMS
 
     covered = {seam for _, seam in SOLVER_SPECS}
+    covered |= {seam for _, seam in BALANCE_SPECS}
     covered |= {spec.split(":", 1)[0] for spec, _ in SERVE_SPECS}
     covered |= set(FLEET_CHAOS_SEAMS)
     assert covered == set(faults.SEAMS), (

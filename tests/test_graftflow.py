@@ -832,6 +832,30 @@ def test_r11_keyword_static_binding():
     assert rules_of(vs) == ["R11"]
 
 
+def test_r11_balance_action_precompile_loop_is_quiet():
+    """ISSUE 15's per-action entry warm-up: a bounded literal loop over
+    the balance action names into a static arg is exactly the sanctioned
+    precompile pattern — one compile per action, no churn."""
+    vs = flow(R11_ENTRY + """
+    def warm(x):
+        for action in ("skip", "ring", "pair", "steal"):
+            f(x, action)
+    """)
+    assert vs == []
+
+
+def test_r11_ppermute_perm_table_as_static_fires():
+    """A ppermute perm table is a list of pairs; binding one to a jit
+    STATIC arg is the unhashable/recompile hazard the balance collectives
+    avoid by closing over the table instead."""
+    vs = flow(R11_ENTRY + """
+    def call(x):
+        perm = [(0, 1), (1, 0)]
+        return f(x, perm)
+    """)
+    assert rules_of(vs) == ["R11"]
+
+
 # -- R12: collective/axis-name consistency -------------------------------------
 
 
@@ -951,6 +975,43 @@ def test_r12_collective_inside_nested_lambda_is_checked():
                          in_specs=(P("ranks"),), out_specs=P("ranks"))
     """)
     assert rules_of(vs) == ["R12"]
+
+
+R12_STEAL_BODY = """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    RANK_AXIS = "ranks"
+
+    def build(mesh):
+        def steal_body(nodes, cnt, round_i):
+            all_c = jax.lax.all_gather(cnt, RANK_AXIS)
+            me = jax.lax.axis_index(RANK_AXIS)
+            slabs = jax.lax.all_gather(nodes, {slab_axis})
+            donor = jnp.searchsorted(all_c, cnt, side="right") - 1
+            return slabs[donor], all_c[me] + round_i
+        return shard_map(steal_body, mesh=mesh,
+                         in_specs=(P(RANK_AXIS), P(RANK_AXIS), P()),
+                         out_specs=(P(RANK_AXIS), P(RANK_AXIS)))
+"""
+
+
+def test_r12_steal_collective_matching_axes_quiet():
+    """ISSUE 15's steal collective shape — all-gathered counts feeding a
+    searchsorted donor route plus a slab all_gather, every collective
+    under RANK_AXIS — must lint clean as written."""
+    vs = flow(R12_STEAL_BODY.format(slab_axis="RANK_AXIS"))
+    assert vs == []
+
+
+def test_r12_steal_collective_axis_drift_fires():
+    """The same body with ONE collective's axis drifted (the slab gather
+    on a stale name) is exactly the drift R12 exists to catch."""
+    vs = flow(R12_STEAL_BODY.format(slab_axis='"rank"'))
+    assert rules_of(vs) == ["R12"]
+    assert "'rank'" in vs[0].message
 
 
 def test_r12_scopes_are_baselineable(tmp_path):

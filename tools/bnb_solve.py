@@ -121,6 +121,11 @@ def result_payload(res, inst, args) -> dict:
         "obs": {
             **_reporting.obs_block(trace_path=_tracing.TRACER.path),
             "rank_balance": getattr(res, "rank_balance", None),
+            # adaptive balance controller accounting (ISSUE 15):
+            # per-round decisions, moved rows/bytes, CV trajectory —
+            # present (not null) even under TSP_OBS=off for sharded
+            # solves; tools/obs_report.py --balance renders it
+            "balance": getattr(res, "balance", None),
         },
     }
 
@@ -187,10 +192,13 @@ def main() -> int:
         "full k*n block)",
     )
     ap.add_argument(
-        "--balance", default="pair", choices=["pair", "ring"],
+        "--balance", default="pair",
+        choices=["pair", "ring", "steal", "adaptive"],
         help="sharded load-balance scheme: pair (richest donates to "
-        "poorest each round — O(1) flattening) or ring (successor "
-        "donation, the r4 scheme)",
+        "poorest each round — O(1) flattening), ring (successor "
+        "donation, the r4 scheme), steal (one-collective global "
+        "repartition), or adaptive (telemetry-driven skip/pair/steal "
+        "per round with hysteresis — ISSUE 15)",
     )
     ap.add_argument(
         "--reorder-every", type=int, default=0,

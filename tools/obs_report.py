@@ -242,6 +242,78 @@ def render_ranks(path: str) -> str:
     return "\n".join(out)
 
 
+def render_balance(path: str) -> str:
+    """Render a driver payload's ``obs.balance`` block (ISSUE 15): the
+    adaptive controller's per-round decisions as a run-length timeline,
+    moved rows/bytes, and the occupancy-CV trajectory as a sparkline.
+
+    A payload WITHOUT the block — a single-device solve, or one from a
+    build predating the controller — is an error (exit 2), not an empty
+    section: the caller explicitly asked for balance evidence, and a
+    healthy-looking nothing would hide that the run never produced it
+    (same posture as --ranks)."""
+    out: List[str] = []
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                doc = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            bal = (doc.get("obs") or {}).get("balance") if isinstance(
+                doc, dict
+            ) else None
+            if not bal:
+                continue
+            name = doc.get("instance", "?")
+            out.append(
+                f"== balance {path} [{name}]: mode {bal['mode']} "
+                f"(base {bal['base']}), {bal['ranks']} ranks, "
+                f"k {bal['k']}, t_slots {bal['t_slots']} =="
+            )
+            mix = ", ".join(
+                f"{a}: {c}" for a, c in sorted(bal["actions"].items())
+            ) or "none"
+            out.append(
+                f"  decisions: {mix}  (collective dispatches "
+                f"{bal['collective_dispatches']}, switches "
+                f"{bal['switches']}, steal degraded "
+                f"{bal['steal_degraded']}, alive probes "
+                f"{bal['alive_probes']})"
+            )
+            out.append(
+                f"  moved: {bal['moved_rows_total']} rows / "
+                f"{bal['moved_bytes_total']} B  cv last "
+                f"{bal['cv_last']} max {bal['cv_max']}"
+            )
+            rows = bal.get("rows") or []
+            if rows:
+                dropped = int(bal.get("rows_dropped", 0))
+                suffix = f" ({dropped} rolled off)" if dropped else ""
+                out.append(f"  cv trajectory ({len(rows)} rounds{suffix}):")
+                out.append(f"    {_sparkline([r[2] for r in rows], lo=0)}")
+                # run-length decision timeline: "pair x12 -> skip x40 ..."
+                runs: List[List] = []
+                for r in rows:
+                    if runs and runs[-1][0] == r[1]:
+                        runs[-1][1] += 1
+                    else:
+                        runs.append([r[1], 1])
+                out.append(
+                    "  timeline: "
+                    + " -> ".join(f"{a} x{c}" for a, c in runs)
+                )
+    if not out:
+        raise ValueError(
+            f"no obs.balance block in {path!r} — only sharded solves "
+            "carry the balance controller; re-run tools/bnb_solve.py "
+            "with --ranks >= 1 on a build with the adaptive controller"
+        )
+    return "\n".join(out)
+
+
 def render_fleet(path: str) -> str:
     """Render a fleet front's stats line (ISSUE 11): per-replica state +
     last scrape totals, supervision totals (restarts / re-dispatches /
@@ -438,6 +510,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "block (sharded runs) — per-rank totals, imbalance "
                     "verdict, occupancy heatmap; errors (exit 2) when the "
                     "payload carries no per-rank telemetry")
+    ap.add_argument("--balance", default=None,
+                    help="bnb_solve JSON (line file ok) with an "
+                    "obs.balance block (sharded runs) — adaptive "
+                    "controller decision timeline, moved rows/bytes, CV "
+                    "sparkline; errors (exit 2) when the payload carries "
+                    "no balance block")
     ap.add_argument("--fleet", default=None,
                     help="fleet front stats JSON (line file ok) — "
                     "per-replica scrape totals, supervision counters, "
@@ -453,12 +531,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="max traces to render")
     args = ap.parse_args(argv)
     if not (
-        args.trace or args.series or args.ranks or args.fleet
-        or args.serve or args.metrics
+        args.trace or args.series or args.ranks or args.balance
+        or args.fleet or args.serve or args.metrics
     ):
         ap.error(
-            "give at least one of --trace / --series / --ranks / --fleet "
-            "/ --serve / --metrics"
+            "give at least one of --trace / --series / --ranks / "
+            "--balance / --fleet / --serve / --metrics"
         )
     sections = []
     try:
@@ -468,6 +546,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             sections.append(render_series(args.series))
         if args.ranks:
             sections.append(render_ranks(args.ranks))
+        if args.balance:
+            sections.append(render_balance(args.balance))
         if args.fleet:
             sections.append(render_fleet(args.fleet))
         if args.serve:

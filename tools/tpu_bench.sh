@@ -50,6 +50,17 @@ if [ ! -s BENCH_STEP_FUSED_TPU.json ]; then
         append BENCH_STEP_FUSED_TPU.json --mode step --backend tpu || true
 fi
 
+if [ ! -s BENCH_BALANCE_TPU.json ]; then
+    echo "== adaptive load balance A/B (ISSUE 15; CPU virtual mesh — the"
+    echo "   controller/collective logic is backend-agnostic, the leg runs"
+    echo "   here so the TPU capture set carries the same artifact) =="
+    TSP_BENCH=balance TSP_BENCH_BALANCE_OUT=BENCH_BALANCE_TPU.json \
+        TSP_BENCH_HISTORY=off python bench.py 2> >(tail -3 >&2) | tail -1
+    [ -s BENCH_BALANCE_TPU.json ] || rm -f BENCH_BALANCE_TPU.json
+    [ -s BENCH_BALANCE_TPU.json ] && python tools/bench_check.py \
+        append BENCH_BALANCE_TPU.json --mode balance --backend tpu || true
+fi
+
 if [ ! -s BENCH_BNB_TPU_R5.json ]; then
     echo "== r5 B&B eil51 recapture (north-star metric, final engine) =="
     TSP_BENCH=bnb TSP_BENCH_HISTORY=off python bench.py 2> >(tail -3 >&2) | tee BENCH_BNB_TPU_R5.json

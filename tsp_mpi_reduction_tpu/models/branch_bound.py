@@ -39,7 +39,9 @@ Design (TPU-first):
 
 from __future__ import annotations
 
+import hashlib
 import time
+from collections import OrderedDict
 from dataclasses import dataclass
 from functools import partial
 from typing import NamedTuple, Optional, Tuple
@@ -54,6 +56,7 @@ from ..obs import metrics as _obs_metrics
 from ..obs import rankview as _obs_rank
 from ..obs import timeseries as _obs_series
 from ..obs import tracing as _obs_tracing
+from ..parallel import balance as _par_balance
 from ..perf import compile_cache as _perf_cache
 from ..perf import donation as _donation
 from ..resilience import checkpoint as _ckpt_store
@@ -410,6 +413,14 @@ class BnBResult:
     #: counts, per-rank spill totals); sharded solves only, None under
     #: ``TSP_OBS=off``
     rank_balance: Optional[dict] = None
+    #: adaptive-balance controller accounting (parallel.balance, ISSUE 15):
+    #: per-dispatch action mix (skip/ring/pair/steal), mode switches,
+    #: moved rows/bytes, CV trajectory, steal-fault degrades. Sharded
+    #: solves only; present even under ``TSP_OBS=off`` — the controller
+    #: is an optimization, not telemetry, and its signal (the spill
+    #: path's counts readback + the alive-counts collective) never goes
+    #: through the obs switch
+    balance: Optional[dict] = None
 
 
 def nearest_neighbor_tour(d: np.ndarray, start: int = 0) -> np.ndarray:
@@ -2593,23 +2604,21 @@ def _apply_keeps(
     return Frontier(nodes, counts_dev, fr.overflow)
 
 
-def _pair_assignment(all_c, round_i, num_ranks: int, t_slots: int):
-    """The pair-balance matching, as a pure function of the (invariant)
-    all-gathered counts: richest donates to poorest, 2nd-richest to
-    2nd-poorest, ... with a tie-break that rotates with ``round_i``.
+# the pair matching now lives with the other balance math in
+# parallel.balance (ISSUE 15); re-exported under the old name for the
+# existing mesh-free property tests
+_pair_assignment = _par_balance.pair_assignment
 
-    Returns ``(m_of, partner_of)``: per-rank donation size and mirror
-    partner. Extracted from the shard_map closure so the starvation
-    properties are unit-testable without a mesh (tests/test_bnb.py).
-    """
-    rot = (jnp.arange(num_ranks, dtype=jnp.int32) + round_i) % num_ranks
-    order = jnp.lexsort((rot, -all_c))  # count desc, rotating ties
-    pos = jnp.argsort(order)  # pos[r] = rank r's position in that order
-    partner_of = order[num_ranks - 1 - pos]  # [R]: my mirror rank
-    donor = pos < (num_ranks // 2)  # odd R: middle rank pairs itself
-    gap = all_c - all_c[partner_of]
-    m_of = jnp.where(donor, jnp.clip(gap // 2, 0, t_slots), 0)  # [R]
-    return m_of, partner_of
+
+#: cross-solve cache of the per-action sharded step entries, LRU-bounded.
+#: Keyed on (mesh device ids, config signature): the per-action bodies
+#: close over statics only, so two solves with the same mesh/config can
+#: share one traced+compiled executable set — repeated sharded solves
+#: (serve sessions, chunked campaigns, test suites) stop paying a fresh
+#: trace per call, and the no-retrace acceptance test wraps a SECOND
+#: solve in analysis.contracts.RecompilationGuard over these very jits.
+_SHARD_ENTRIES: "OrderedDict[tuple, dict]" = OrderedDict()
+_SHARD_ENTRIES_MAX = 8
 
 
 def solve_sharded(
@@ -2656,13 +2665,21 @@ def solve_sharded(
 
     Load balance (``balance``): after every inner batch ranks exchange up
     to ``transfer`` top-of-stack nodes inside the compiled program
-    (amounts are data-dependent but shapes are static). ``"pair"``
-    (default) matches richest with poorest from the all-gathered counts
-    and donates half the gap directly — flattens any skew in O(1) rounds.
-    ``"ring"`` donates to the ring successor via ``ppermute`` (the ICI
-    version of MPI work-stealing) — cheaper per round but needs
-    ~num_ranks diffusion hops and measurably strands ranks (VERDICT r4
-    weak #4: 12,554x max/min node imbalance on eil51 ranks=8).
+    (amounts are data-dependent but shapes are static; the collectives
+    live in :mod:`..parallel.balance`). ``"pair"`` (default) matches
+    richest with poorest from the all-gathered counts and donates half
+    the gap directly — flattens any skew in O(1) rounds. ``"ring"``
+    donates to the ring successor via ``ppermute`` (the ICI version of
+    MPI work-stealing) — cheaper per round but needs ~num_ranks diffusion
+    hops and measurably strands ranks (VERDICT r4 weak #4: 12,554x
+    max/min node imbalance on eil51 ranks=8). ``"steal"`` globally
+    repartitions surplus live rows from the richest ranks to the starved
+    ones in one collective. ``"adaptive"`` (ISSUE 15) closes the loop: a
+    host-side controller reads the per-rank occupancy counts between
+    dispatches and picks skip / pair / steal per round with hysteresis —
+    each action is its own precompiled fixed-shape entry, so mode
+    switches never retrace; decisions, moved rows, and the CV trajectory
+    land in ``BnBResult.balance`` (the ``obs.balance`` payload block).
 
     ``seed_mode``: "round-robin" (default) splits the root's children over
     ranks; "single-rank" piles them all on rank 0 — the adversarial case
@@ -2781,129 +2798,283 @@ def solve_sharded(
     # (parking at capacity_per_rank would write garbage into padding row 0)
     phys_rows = int(fr.nodes.shape[-2])
 
-    def ring_balance(f2: Frontier, round_i) -> Frontier:
-        """Diffuse work around the ring: donate top-of-stack nodes to the
-        successor while I hold more than it. Donation size is capped so the
-        receiver can never overflow (recv + m <= (donor + recv)/2 + recv <=
-        capacity while donor <= capacity). ``round_i`` unused (the ring
-        route is fixed)."""
-        cnt = f2.count
-        nb_cnt = jax.lax.ppermute(cnt, RANK_AXIS, perm_back)  # successor's count
-        m_out = jnp.clip((cnt - nb_cnt) // 2, 0, t_slots)
-        lanes_t = jnp.arange(t_slots, dtype=jnp.int32)
-        src = jnp.clip(cnt - m_out + lanes_t, 0, capacity_per_rank - 1)
-        m_in = jax.lax.ppermute(m_out, RANK_AXIS, perm_fwd)
-        base = cnt - m_out
-        dest = jnp.where(lanes_t < m_in, base + lanes_t, phys_rows)
-        recv = jax.lax.ppermute(f2.nodes[src], RANK_AXIS, perm_fwd)
-        return Frontier(
-            f2.nodes.at[dest].set(recv, mode="drop"), base + m_in, f2.overflow
+    # ---- adaptive load balance (ISSUE 15) -------------------------------
+    # The balance collectives themselves live in parallel.balance (ring /
+    # pair kept verbatim, steal new); this block builds ONE sharded step
+    # executable PER ACTION so the host-side controller can switch modes
+    # between dispatches without ever retracing: the action is folded into
+    # the AOT entry name, every needed entry is precompiled at setup, and
+    # dispatch just selects among ready executables.
+    if balance not in ("ring", "pair", "steal", "adaptive"):
+        raise ValueError(
+            f"unknown balance {balance!r} (expected ring|pair|steal|adaptive)"
         )
-
-    def pair_balance(f2: Frontier, round_i) -> Frontier:
-        """Pair the richest rank with the poorest (2nd-richest with
-        2nd-poorest, ...) every round and donate half the count gap
-        directly — O(1) rounds to flatten any skew, where the ring needs
-        O(num_ranks) diffusion hops and in practice left a 12,554x max/min
-        per-rank node imbalance on eil51 ranks=8 (VERDICT r4 weak #4).
-
-        The pairing is computed identically on every rank from the
-        all-gathered counts (axis-invariant data), then each rank plays its
-        own (varying) role in it. Slabs move via ``all_gather`` + local
-        select: ``ppermute`` cannot route them because its permutation must
-        be static and the rich->poor matching is data-dependent. That costs
-        num_ranks*t_slots rows on the wire per round vs the ring's t_slots,
-        but these slabs are tiny next to the frontier itself and the
-        exchange stays inside the compiled program.
-
-        Overflow-safe for the same reason the ring is: a receiver ends at
-        (donor + receiver)/2 <= capacity while every donor <= capacity.
-
-        The tie-break among equal counts ROTATES with ``round_i``: with
-        more poor ranks than rich ones (eil51 after batch 1: five drained
-        ranks, three rich), a stable sort parks the same drained rank in
-        the donor half every round — paired with another drained rank,
-        fed nothing, forever (measured: rank 0 stuck at 7 expanded nodes
-        for a whole 238k-node run). Rotating the tie order time-shares
-        the unfed slots instead.
-        """
-        cnt = f2.count
-        all_c = jax.lax.all_gather(cnt, RANK_AXIS)  # [R], invariant
-        m_of, partner_of = _pair_assignment(all_c, round_i, num_ranks, t_slots)
-        me = jax.lax.axis_index(RANK_AXIS)
-        m_out = m_of[me]
-        partner = partner_of[me]
-        m_in = m_of[partner]  # 0 unless my partner donates (to me)
-        lanes_t = jnp.arange(t_slots, dtype=jnp.int32)
-        src = jnp.clip(cnt - m_out + lanes_t, 0, capacity_per_rank - 1)
-        slabs = jax.lax.all_gather(f2.nodes[src], RANK_AXIS)  # [R, t, width]
-        base = cnt - m_out
-        dest = jnp.where(lanes_t < m_in, base + lanes_t, phys_rows)
-        return Frontier(
-            f2.nodes.at[dest].set(slabs[partner], mode="drop"),
-            base + m_in,
-            f2.overflow,
-        )
-
-    if balance not in ("ring", "pair"):
-        raise ValueError(f"unknown balance {balance!r} (expected ring|pair)")
-    balance_fn = {"ring": ring_balance, "pair": pair_balance}[balance]
-
-    def rank_body(fr_stacked, ic_l, itour_l, d_rep, mo_rep, ba_rep, dbar_rep,
-                  pi_rep, slack_rep, step_rep, budget_rep, it_rep):
-        local = Frontier(*(x[0] for x in fr_stacked))
-        f2, c2, t2, nodes = _expand_loop(
-            local, ic_l[0], itour_l[0], d_rep, mo_rep, ba_rep, dbar_rep,
-            pi_rep, slack_rep, step_rep, budget_rep, k, n, inner_steps,
-            integral, mst_prune, node_ascent, mst_kernel, push_order,
-            push_block, step_kernel
-        )
-        if num_ranks > 1:
-            f2 = balance_fn(f2, it_rep)
-        all_c = jax.lax.all_gather(c2, RANK_AXIS)
-        all_t = jax.lax.all_gather(t2, RANK_AXIS)
-        b = jnp.argmin(all_c)
-        total_nodes = jax.lax.psum(nodes, RANK_AXIS)
-        rank_nodes = jax.lax.all_gather(nodes, RANK_AXIS)
-        return (
-            jax.tree.map(lambda x: x[None], tuple(f2)),
-            all_c[b][None],
-            all_t[b][None],
-            total_nodes[None],
-            rank_nodes[None],
-        )
-
-    # the stacked per-rank frontier (arg 0) is donated on every sharded
-    # dispatch — same in-place aliasing as the single-device entries; the
-    # host loop rebinds it from the output immediately
-    step = jax.jit(
-        shard_map(
-            rank_body,
-            mesh=mesh,
-            in_specs=(
-                tuple(P(RANK_AXIS) for _ in Frontier._fields),
-                P(RANK_AXIS),
-                P(RANK_AXIS),
-                P(None, None),
-                P(None),
-                P(None),
-                P(None, None),
-                P(None),
-                P(),
-                P(),
-                P(),
-                P(),
-            ),
-            out_specs=(
-                tuple(P(RANK_AXIS) for _ in Frontier._fields),
-                P(RANK_AXIS),
-                P(RANK_AXIS),
-                P(RANK_AXIS),
-                P(RANK_AXIS),
-            ),
-        ),
-        donate_argnums=(0,),
+    adaptive_balance = balance == "adaptive"
+    base_action = "pair" if adaptive_balance else balance
+    _bal_kw = dict(
+        num_ranks=num_ranks, t_slots=t_slots, capacity=capacity_per_rank,
+        phys_rows=phys_rows, perm_fwd=perm_fwd, perm_back=perm_back,
     )
+
+    def _apply_balance(action, f2, round_i):
+        nodes2, cnt2, m_out = _par_balance.apply(
+            action, f2.nodes, f2.count, round_i, **_bal_kw
+        )
+        return Frontier(nodes2, cnt2, f2.overflow), m_out
+
+    def _make_rank_body(action):
+        # host-loop mode: one inner batch per dispatch, balance after it.
+        # New vs the pre-adaptive body: the per-rank donated-row counts
+        # come back as a sixth output so the host can account moved
+        # rows/bytes per dispatch.
+        def rank_body(fr_stacked, ic_l, itour_l, d_rep, mo_rep, ba_rep,
+                      dbar_rep, pi_rep, slack_rep, step_rep, budget_rep,
+                      it_rep):
+            local = Frontier(*(x[0] for x in fr_stacked))
+            f2, c2, t2, nodes = _expand_loop(
+                local, ic_l[0], itour_l[0], d_rep, mo_rep, ba_rep, dbar_rep,
+                pi_rep, slack_rep, step_rep, budget_rep, k, n, inner_steps,
+                integral, mst_prune, node_ascent, mst_kernel, push_order,
+                push_block, step_kernel
+            )
+            mv = c2 * 0
+            if num_ranks > 1 and action != "skip":
+                f2, m_out = _apply_balance(action, f2, it_rep)
+                mv = mv + m_out
+            all_c = jax.lax.all_gather(c2, RANK_AXIS)
+            all_t = jax.lax.all_gather(t2, RANK_AXIS)
+            b = jnp.argmin(all_c)
+            total_nodes = jax.lax.psum(nodes, RANK_AXIS)
+            rank_nodes = jax.lax.all_gather(nodes, RANK_AXIS)
+            rank_moved = jax.lax.all_gather(mv, RANK_AXIS)
+            return (
+                jax.tree.map(lambda x: x[None], tuple(f2)),
+                all_c[b][None],
+                all_t[b][None],
+                total_nodes[None],
+                rank_nodes[None],
+                rank_moved[None],
+            )
+
+        return rank_body
+
+    # the device-resident outer loop (device_loop mode): MANY rounds of
+    # [inner_steps guarded expansion steps -> balance -> incumbent
+    # all_gather] run inside ONE dispatch. Each round's expansion is
+    # _guarded_expand_steps — the same per-step compaction/full-stop
+    # machinery as _solve_device, so a rank can never overflow-drop
+    # (growth per step <= k*(n-1) = the reserved headroom). A round also
+    # computes a `done` flag (mesh drained, a rank irreducibly full ->
+    # host must spill, or overflow tripped) consumed by the while cond
+    # NEXT iteration, keeping collectives out of cond. The controller's
+    # action holds for every round of the dispatch (decisions live at
+    # guarded-step boundaries); donated-row counts accumulate in the
+    # while carry so the host still sees the dispatch's full total.
+    loop_headroom = min(capacity_per_rank // 4, k * (n - 1))
+
+    def _make_rank_body_loop(action):
+        def rank_body_loop(fr_stacked, ic_l, itour_l, d_rep, mo_rep, ba_rep,
+                           dbar_rep, pi_rep, slack_rep, step_rep, budget_rep,
+                           max_rounds_rep, it0_rep):
+            local = Frontier(*(x[0] for x in fr_stacked))
+
+            def cond(c):
+                _, _, _, _, i, done, _ = c
+                return (i < max_rounds_rep) & ~done
+
+            def body(c):
+                fr, icc, itc, nds, i, _, mv = c
+                fr, icc, itc, dn, _, _ = _guarded_expand_steps(
+                    fr, icc, itc, d_rep, mo_rep, ba_rep, dbar_rep, pi_rep,
+                    slack_rep, step_rep, budget_rep, jnp.asarray(inner_steps),
+                    k, n, integral, mst_prune, node_ascent,
+                    reorder_every=reorder_every,
+                    step0=it0_rep + i * inner_steps,
+                    mst_kernel=mst_kernel,
+                    push_order=push_order,
+                    push_block=push_block,
+                    step_kernel=step_kernel,
+                )
+                if num_ranks > 1 and action != "skip":
+                    # round_i counts BALANCE EVENTS, not steps: step counts
+                    # advance by inner_steps, and inner_steps % num_ranks == 0
+                    # would freeze the pair tie rotation
+                    fr, m_out = _apply_balance(
+                        action, fr, it0_rep // max(inner_steps, 1) + i
+                    )
+                    mv = mv + m_out
+                all_c = jax.lax.all_gather(icc, RANK_AXIS)
+                all_t = jax.lax.all_gather(itc, RANK_AXIS)
+                sel = jnp.argmin(all_c)
+                icc, itc = all_c[sel], all_t[sel]
+                full = fr.count > capacity_per_rank - loop_headroom
+                stop = full | fr.overflow
+                any_stop = jax.lax.psum(stop.astype(jnp.int32), RANK_AXIS) > 0
+                total = jax.lax.psum(fr.count, RANK_AXIS)
+                # psum/all-reduce results are axis-invariant; the carry slot
+                # was initialized from a varying value, so re-mark it varying
+                # (identity on jax builds without VMA tracking — backend
+                # compat)
+                done = pcast_varying((total == 0) | any_stop, RANK_AXIS)
+                return fr, icc, itc, nds + dn, i + 1, done, mv
+
+            zero = local.count * 0
+            fr, icc, itc, nds, steps, _, mv = jax.lax.while_loop(
+                cond, body,
+                (local, ic_l[0], itour_l[0], zero, zero, local.count < 0,
+                 zero),
+            )
+            total_nodes = jax.lax.psum(nds, RANK_AXIS)
+            rank_nodes = jax.lax.all_gather(nds, RANK_AXIS)
+            rank_moved = jax.lax.all_gather(mv, RANK_AXIS)
+            return (
+                jax.tree.map(lambda x: x[None], tuple(fr)),
+                icc[None],
+                itc[None],
+                total_nodes[None],
+                rank_nodes[None],
+                steps[None],
+                rank_moved[None],
+            )
+
+        return rank_body_loop
+
+    _in_specs_step = (
+        tuple(P(RANK_AXIS) for _ in Frontier._fields),
+        P(RANK_AXIS),
+        P(RANK_AXIS),
+        P(None, None),
+        P(None),
+        P(None),
+        P(None, None),
+        P(None),
+        P(),
+        P(),
+        P(),
+        P(),
+    )
+    _out_specs_step = (
+        tuple(P(RANK_AXIS) for _ in Frontier._fields),
+        P(RANK_AXIS),
+        P(RANK_AXIS),
+        P(RANK_AXIS),
+        P(RANK_AXIS),
+        P(RANK_AXIS),
+    )
+    _in_specs_loop = _in_specs_step + (P(),)
+    _out_specs_loop = _out_specs_step + (P(RANK_AXIS),)
+
+    # per-(mesh, config) entry set, shared ACROSS solves: the bodies close
+    # over static config only, so a repeated same-config solve (serve
+    # sessions, chunked campaigns, the test suite) reuses the already
+    # traced/compiled executables — the no-retrace acceptance test wraps a
+    # second solve in RecompilationGuard over exactly these jits
+    mode_tag = "loop" if device_loop else "step"
+    entry_cfg = (
+        mode_tag, num_ranks, capacity_per_rank, phys_rows, k, n,
+        inner_steps, bool(integral), bool(mst_prune), node_ascent,
+        mst_kernel, push_order, push_block, step_kernel, reorder_every,
+        t_slots, FRONTIER_LAYOUT_VERSION,
+    )
+    cfg_sig = hashlib.blake2b(
+        repr(entry_cfg).encode(), digest_size=6
+    ).hexdigest()
+    dev_key = tuple(int(dv.id) for dv in mesh.devices.flat)
+    entries = _SHARD_ENTRIES.get((dev_key, cfg_sig))
+    if entries is None:
+        entries = {"jit": {}, "aot": {}}
+        _SHARD_ENTRIES[(dev_key, cfg_sig)] = entries
+        while len(_SHARD_ENTRIES) > _SHARD_ENTRIES_MAX:
+            _SHARD_ENTRIES.popitem(last=False)
+    else:
+        _SHARD_ENTRIES.move_to_end((dev_key, cfg_sig))
+
+    def _entry(action):
+        # the stacked per-rank frontier (arg 0) is donated on every
+        # sharded dispatch — same in-place aliasing as the single-device
+        # entries; the host loop rebinds it from the output immediately
+        fn = entries["jit"].get(action)
+        if fn is None:
+            fn = jax.jit(
+                shard_map(
+                    (_make_rank_body_loop if device_loop
+                     else _make_rank_body)(action),
+                    mesh=mesh,
+                    in_specs=(
+                        _in_specs_loop if device_loop else _in_specs_step
+                    ),
+                    out_specs=(
+                        _out_specs_loop if device_loop else _out_specs_step
+                    ),
+                ),
+                donate_argnums=(0,),
+            )
+            entries["jit"][action] = fn
+        return fn
+
+    # precompile every action this run can pick, at setup, through the
+    # AOT store (paid/saved seconds land in the compile_cache stats
+    # block): a mid-solve action switch must select a READY executable,
+    # never pay a trace/compile inside the timed loop. load_or_build
+    # returns a Compiled even with the cache disabled.
+    if num_ranks <= 1:
+        needed_actions = ("skip",)
+    elif adaptive_balance:
+        needed_actions = tuple(dict.fromkeys(("skip", base_action, "steal")))
+    else:
+        needed_actions = (base_action,)
+    if device_loop:
+        example_tail = (jnp.asarray(1, jnp.int32), jnp.asarray(0, jnp.int32))
+    else:
+        example_tail = (jnp.asarray(0, jnp.int32),)
+    example_args = (
+        tuple(fr), ic, itour, d32, min_out, bound_adj, bd.dbar, bd.pi,
+        bd.slack, bd.ascent_step, bd.lam_budget,
+    ) + example_tail
+    entry_prefix = f"shard_{mode_tag}"
+    for _a in needed_actions:
+        if entries["aot"].get(_a) is not None:
+            continue
+        try:
+            entries["aot"][_a] = _perf_cache.load_or_build(
+                f"{entry_prefix}.{_a}.{cfg_sig}", _entry(_a), example_args
+            )
+        except Exception:
+            # precompile is an optimization, never a correctness gate:
+            # the plain jit dispatch below stays authoritative
+            entries["aot"][_a] = None
+
+    def _dispatch(action, args):
+        aot = entries["aot"].get(action)
+        if aot is not None:
+            try:
+                return aot(*args)
+            except TypeError:
+                # aval drift vs the stored executable (arg validation
+                # happens before execution, so nothing was consumed) —
+                # the jit path is authoritative; degrade this entry for
+                # the rest of the process
+                entries["aot"][action] = None
+                _perf_cache.STATS.record(
+                    f"{entry_prefix}.{action}.{cfg_sig}", "error"
+                )
+        return _entry(action)(*args)
+
+    # the host-side controller: picks each dispatch's action from the
+    # per-rank occupancy counts the spill path already reads back — no
+    # telemetry dependency, so it keeps working under TSP_OBS=off
+    controller = _par_balance.BalanceController(
+        num_ranks=num_ranks, k=k, t_slots=t_slots, base=base_action,
+        adaptive=adaptive_balance,
+        row_bytes=int(fr.nodes.shape[-1]) * 4,
+    )
+    rank_alive_counts = None
+    if adaptive_balance and num_ranks > 1:
+        # escalation confirmation probe: device-side ALIVE counts
+        # (parallel.reduce collective — solver machinery, not telemetry)
+        from ..parallel.reduce import make_rank_alive_counts
+
+        rank_alive_counts = make_rank_alive_counts(mesh, integral=integral)
 
     # per-rank best-bound-first re-sort (host-loop mode; the device loop
     # does it in-kernel via step0 cadence): one shard-mapped
@@ -2923,105 +3094,6 @@ def solve_sharded(
             mesh=mesh,
             in_specs=(tuple(P(RANK_AXIS) for _ in Frontier._fields),),
             out_specs=tuple(P(RANK_AXIS) for _ in Frontier._fields),
-        ),
-        donate_argnums=(0,),
-    )
-
-    # the device-resident outer loop (device_loop mode): MANY rounds of
-    # [inner_steps guarded expansion steps -> ring balance -> incumbent
-    # all_gather] run inside ONE dispatch. Each round's expansion is
-    # _guarded_expand_steps — the same per-step compaction/full-stop
-    # machinery as _solve_device, so a rank can never overflow-drop
-    # (growth per step <= k*(n-1) = the reserved headroom). A round also
-    # computes a `done` flag (mesh drained, a rank irreducibly full ->
-    # host must spill, or overflow tripped) consumed by the while cond
-    # NEXT iteration, keeping collectives out of cond.
-    loop_headroom = min(capacity_per_rank // 4, k * (n - 1))
-
-    def rank_body_loop(fr_stacked, ic_l, itour_l, d_rep, mo_rep, ba_rep,
-                       dbar_rep, pi_rep, slack_rep, step_rep, budget_rep,
-                       max_rounds_rep, it0_rep):
-        local = Frontier(*(x[0] for x in fr_stacked))
-
-        def cond(c):
-            _, _, _, _, i, done = c
-            return (i < max_rounds_rep) & ~done
-
-        def body(c):
-            fr, icc, itc, nds, i, _ = c
-            fr, icc, itc, dn, _, _ = _guarded_expand_steps(
-                fr, icc, itc, d_rep, mo_rep, ba_rep, dbar_rep, pi_rep,
-                slack_rep, step_rep, budget_rep, jnp.asarray(inner_steps),
-                k, n, integral, mst_prune, node_ascent,
-                reorder_every=reorder_every,
-                step0=it0_rep + i * inner_steps,
-                mst_kernel=mst_kernel,
-                push_order=push_order,
-                push_block=push_block,
-                step_kernel=step_kernel,
-            )
-            if num_ranks > 1:
-                # round_i counts BALANCE EVENTS, not steps: step counts
-                # advance by inner_steps, and inner_steps % num_ranks == 0
-                # would freeze the tie rotation
-                fr = balance_fn(fr, it0_rep // max(inner_steps, 1) + i)
-            all_c = jax.lax.all_gather(icc, RANK_AXIS)
-            all_t = jax.lax.all_gather(itc, RANK_AXIS)
-            sel = jnp.argmin(all_c)
-            icc, itc = all_c[sel], all_t[sel]
-            full = fr.count > capacity_per_rank - loop_headroom
-            stop = full | fr.overflow
-            any_stop = jax.lax.psum(stop.astype(jnp.int32), RANK_AXIS) > 0
-            total = jax.lax.psum(fr.count, RANK_AXIS)
-            # psum/all-reduce results are axis-invariant; the carry slot was
-            # initialized from a varying value, so re-mark it varying
-            # (identity on jax builds without VMA tracking — backend compat)
-            done = pcast_varying((total == 0) | any_stop, RANK_AXIS)
-            return fr, icc, itc, nds + dn, i + 1, done
-
-        zero = local.count * 0
-        fr, icc, itc, nds, steps, _ = jax.lax.while_loop(
-            cond, body,
-            (local, ic_l[0], itour_l[0], zero, zero, local.count < 0),
-        )
-        total_nodes = jax.lax.psum(nds, RANK_AXIS)
-        rank_nodes = jax.lax.all_gather(nds, RANK_AXIS)
-        return (
-            jax.tree.map(lambda x: x[None], tuple(fr)),
-            icc[None],
-            itc[None],
-            total_nodes[None],
-            rank_nodes[None],
-            steps[None],
-        )
-
-    step_loop = jax.jit(
-        shard_map(
-            rank_body_loop,
-            mesh=mesh,
-            in_specs=(
-                tuple(P(RANK_AXIS) for _ in Frontier._fields),
-                P(RANK_AXIS),
-                P(RANK_AXIS),
-                P(None, None),
-                P(None),
-                P(None),
-                P(None, None),
-                P(None),
-                P(),
-                P(),
-                P(),
-                P(),
-                P(),
-            ),
-            out_specs=(
-                tuple(P(RANK_AXIS) for _ in Frontier._fields),
-                P(RANK_AXIS),
-                P(RANK_AXIS),
-                P(RANK_AXIS),
-                P(RANK_AXIS),
-                P(RANK_AXIS),
-            ),
         ),
         donate_argnums=(0,),
     )
@@ -3062,7 +3134,10 @@ def solve_sharded(
             [len(rv) > 0 for rv in reservoirs]
         )
         if not (spilling.any() or refilling.any()):
-            return fr, int(counts.sum())
+            # the counts ride back in every return: the balance
+            # controller's decision signal is this same readback (no
+            # second device->host fetch per dispatch)
+            return fr, int(counts.sum()), counts
         # the device-resident exchange (this PR's tentpole): per-rank
         # frontier alive-minima come from the on-device collective; each
         # affected rank then fetches ONLY its live prefix, best-half
@@ -3153,7 +3228,7 @@ def solve_sharded(
             _contracts.check_frontier(
                 stacked, n=n, where="solve_sharded.spill_refill"
             )
-            return stacked, int(new_counts.sum())
+            return stacked, int(new_counts.sum()), new_counts
 
     if resume_from:
         # a checkpoint written with a smaller k (or the pre-padding
@@ -3161,7 +3236,12 @@ def solve_sharded(
         # shed the overhang to the reservoirs BEFORE the first dispatch
         # (the unguarded host-loop expand would otherwise be forced to
         # clamp its block write and flag exactness lost)
-        fr, _ = spill_refill(fr, inc_cost0)
+        fr, _, _ = spill_refill(fr, inc_cost0)
+
+    # the controller's first decision reads the same per-rank occupancy
+    # counts the spill path uses; paid once here in setup, then refreshed
+    # for free from spill_refill's per-dispatch readback
+    counts_now = _rank_counts(fr.count)
 
     t0 = time.perf_counter()
     setup_s = t0 - t_setup
@@ -3232,6 +3312,38 @@ def solve_sharded(
     while it < max_iters:
         t_iter = time.perf_counter()
         sp_h0, sp_d0 = spill_stats.bytes_to_host, spill_stats.bytes_to_device
+        # pick THIS dispatch's balance action from the current occupancy
+        # counts (hysteresis + escalation live in the controller); the
+        # chaos seam fires host-side on escalation and an injected fault
+        # degrades the round to the base action — the search stays exact
+        # either way, balance only moves rows
+        prev_action = controller.last_action
+        action = controller.decide(
+            counts_now,
+            alive_probe=(
+                (lambda: np.asarray(rank_alive_counts(
+                    fr.nodes, fr.count, jnp.asarray(last_inc, jnp.float32)
+                )))
+                if rank_alive_counts is not None
+                else None
+            ),
+        )
+        if action == "steal":
+            try:
+                _fault_registry().fire("balance.steal")
+            except _TransientFault:
+                action = controller.degrade()
+        controller.count_action(action)
+        if action != prev_action:
+            # a span per decision would drown the trace; stamp SWITCHES,
+            # with the donor/receiver sets the new action will see
+            with _obs_tracing.span(
+                "bnb.balance", step=it, action=action, cv=controller.cv,
+            ) as _bsp:
+                _bsp.event(
+                    "rank_participation",
+                    **controller.participation(counts_now),
+                )
         if device_loop:
             # one in-dispatch round = inner_steps expansion steps; all
             # caps (psum'd int32 counters, checkpoint cadence, CPU-only
@@ -3255,10 +3367,12 @@ def solve_sharded(
             t_disp = time.perf_counter()
             prev_nodes = fr.nodes if _contracts.level() != "off" else None
             with step_ann(it):
-                out = step_loop(tuple(fr), ic, itour, d32, min_out, bound_adj,
-                                bd.dbar, bd.pi, bd.slack, bd.ascent_step,
-                                bd.lam_budget, jnp.asarray(rounds, jnp.int32),
-                                jnp.asarray(it, jnp.int32))
+                out = _dispatch(action, (
+                    tuple(fr), ic, itour, d32, min_out, bound_adj,
+                    bd.dbar, bd.pi, bd.slack, bd.ascent_step,
+                    bd.lam_budget, jnp.asarray(rounds, jnp.int32),
+                    jnp.asarray(it, jnp.int32),
+                ))
             rounds_done = max(int(out[5][0]), 1)
             disp_s = time.perf_counter() - t_disp
             if disp_s > 0:
@@ -3266,9 +3380,11 @@ def solve_sharded(
         else:
             prev_nodes = fr.nodes if _contracts.level() != "off" else None
             with step_ann(it):
-                out = step(tuple(fr), ic, itour, d32, min_out, bound_adj, bd.dbar,
-                           bd.pi, bd.slack, bd.ascent_step, bd.lam_budget,
-                           jnp.asarray(it // max(inner_steps, 1), jnp.int32))
+                out = _dispatch(action, (
+                    tuple(fr), ic, itour, d32, min_out, bound_adj, bd.dbar,
+                    bd.pi, bd.slack, bd.ascent_step, bd.lam_budget,
+                    jnp.asarray(it // max(inner_steps, 1), jnp.int32),
+                ))
             rounds_done = 1
         fr = Frontier(*out[0])
         if prev_nodes is not None:
@@ -3276,6 +3392,11 @@ def solve_sharded(
             _contracts.check_donated(prev_nodes, where="solve_sharded.step")
         ic, itour, step_nodes = out[1], out[2], out[3]
         rank_nodes = rank_nodes + np.asarray(out[4][0])
+        # the dispatch's per-rank donated-row counts (loop mode: summed
+        # over its in-dispatch rounds) — obs.balance accounting
+        controller.record(
+            it, action, np.asarray(out[6 if device_loop else 5][0])
+        )
         nodes += int(step_nodes[0])
         it += rounds_done * inner_steps
         best = float(ic[0])
@@ -3287,7 +3408,7 @@ def solve_sharded(
             for rv in reservoirs:
                 if len(rv):
                     rv.prune(best, integral)
-        fr, total0 = spill_refill(fr, best)
+        fr, total0, counts_now = spill_refill(fr, best)
         if (
             reorder_every
             and not device_loop
@@ -3413,6 +3534,7 @@ def solve_sharded(
         ),
         rank_series=rank_series,
         rank_balance=rank_bal,
+        balance=controller.summary(),
     )
 
 

@@ -316,6 +316,23 @@ DEFAULT_RULES: Dict[str, MetricRule] = {
     "serve_tight_deadline_exact_rate": MetricRule(
         direction="higher", rel_threshold=0.0, abs_threshold=0.02, min_samples=4
     ),
+    # adaptive load balance (ISSUE 15, TSP_BENCH=balance): the adaptive
+    # leg's per-rank node imbalance (nodes max / max(min, 1)) on the
+    # skewed single-rank-seeded config. Healthy values sit near 1-3 and a
+    # ratio near 1 has no meaningful relative band, so the band is
+    # absolute: a controller regression that strands a rank again (the
+    # static-ring regime measures in the hundreds here) jumps the series
+    # far past it
+    "shard_balance_imbalance": MetricRule(
+        direction="lower", rel_threshold=0.0, abs_threshold=5.0, min_samples=4
+    ),
+    # the repartition's traffic price: moved slab bytes per expanded node
+    # on the adaptive leg. Relative band — the healthy value scales with
+    # instance/config, and a silent doubling (escalation stuck on steal,
+    # dead-band broken) is the regression being guarded
+    "shard_steal_bytes_per_node": MetricRule(
+        direction="lower", rel_threshold=0.50, min_samples=4
+    ),
 }
 
 

@@ -284,6 +284,53 @@ def make_rank_alive_min(mesh: jax.sharding.Mesh, integral: bool = False):
     )
 
 
+def make_rank_alive_counts(mesh: jax.sharding.Mesh, integral: bool = False):
+    """Build the per-rank ALIVE row-count collective for ``mesh``.
+
+    The adaptive balance controller (parallel.balance, ISSUE 15) confirms
+    a steal escalation against rows the incumbent has NOT yet closed:
+    occupancy counts alone can nominate a donor whose whole stack is dead
+    weight (pruned for free at the next pop, not worth a collective).
+    This is the controller's dedicated probe — the ``alive`` column of
+    :func:`make_rank_stats` without the bound minimum, so the readback is
+    [R] ints and the decision works with telemetry fully off
+    (``TSP_OBS=off`` gates the rankview sampler, never this).
+
+    Returns a jitted callable ``(nodes [R, F, cols] i32 packed rows,
+    counts [R] i32, inc scalar f32) -> [R] i32`` where element r is rank
+    r's open-row count. Shard-local like its siblings: bound column
+    sliced + bitcast in-kernel, buffer not donated, no cross-rank
+    traffic. ``integral`` selects the fixed-point alive predicate.
+    """
+
+    def body(nodes, counts, inc):
+        rows = nodes[0]  # [F, cols] packed int32 rows
+        # bound lives at column cols-2 (see make_rank_alive_min)
+        b = jax.lax.bitcast_convert_type(rows[:, -2], jnp.float32)
+        pos = jnp.arange(rows.shape[0], dtype=jnp.int32)
+        alive = pos < counts[0]
+        if integral:
+            alive = alive & (b <= inc - 1.0)
+        else:
+            alive = alive & (b < inc)
+        return jnp.sum(alive.astype(jnp.int32))[None]
+
+    # counted at build time on the host, never in the traced body (R8):
+    # one build per (mesh, integral) config per solve is the expectation
+    _REGISTRY.inc(
+        "collectives_built_total", kind="rank_alive_counts",
+        ranks=mesh.devices.size, integral=integral,
+    )
+    return jax.jit(
+        shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(RANK_AXIS), P(RANK_AXIS), P()),
+            out_specs=P(RANK_AXIS),
+        )
+    )
+
+
 #: column order of the [R, K] row ``make_rank_stats`` returns — kept next
 #: to the builder so the rankview consumer (obs.rankview.RankSampler) and
 #: any future column rider agree on indices by name, not by magic number

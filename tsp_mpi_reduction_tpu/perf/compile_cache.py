@@ -321,16 +321,26 @@ def _cost_memo_get(key: str, entry: str) -> None:
 
 
 def _abstract(args: Tuple[Any, ...]):
-    """Concrete example args -> ShapeDtypeStructs (pytree-preserving)."""
-    import jax
+    """Concrete example args -> ShapeDtypeStructs (pytree-preserving).
 
-    return jax.tree_util.tree_map(
-        lambda x: jax.ShapeDtypeStruct(
+    Mesh shardings are carried through: lowering a ``shard_map`` entry
+    from bare shapes would bake fully-replicated input layouts into the
+    executable, and the sharded solver's real (``NamedSharding``-placed)
+    arrays would then fail the ``Compiled`` call's aval check on every
+    dispatch. Single-device placements are deliberately dropped — solve()
+    entries keep compiling exactly as before."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    def _sd(x):
+        sharding = getattr(x, "sharding", None)
+        return jax.ShapeDtypeStruct(
             tuple(getattr(x, "shape", np.shape(x))),
             np.dtype(getattr(x, "dtype", None) or np.asarray(x).dtype),
-        ),
-        args,
-    )
+            sharding=sharding if isinstance(sharding, NamedSharding) else None,
+        )
+
+    return jax.tree_util.tree_map(_sd, args)
 
 
 def _compile_entry(fn, args, statics, timer_name: Optional[str] = None):
@@ -481,6 +491,30 @@ def aot_load_or_compile(
         # the in-process executable is still perfectly valid — only the
         # cross-process store is off for this entry; later processes see
         # the marker and go straight to the jit path
+    return compiled
+
+
+def load_or_build(
+    name: str,
+    fn,
+    args: Tuple[Any, ...],
+    statics: Optional[Dict[str, Any]] = None,
+):
+    """Like :func:`aot_load_or_compile` but ALWAYS returns a ready
+    ``Compiled`` — when the cache is disabled (the library default) or
+    the entry is marked unserializable, it still ``lower().compile()``s
+    through the layer-1 persistent cache instead of returning None.
+
+    For callers that precompile a SET of entries at setup and then
+    dispatch whichever one each round picks (the sharded solver's
+    per-balance-action executables, ISSUE 15): a mid-solve action switch
+    must never pay a fresh trace/compile, so "cache off" cannot mean
+    "compile lazily inside the timed loop"."""
+    compiled = aot_load_or_compile(name, fn, args, statics)
+    if compiled is None:
+        compiled, _ = _compile_entry(
+            fn, args, statics or {}, timer_name=f"compile.{name}"
+        )
     return compiled
 
 

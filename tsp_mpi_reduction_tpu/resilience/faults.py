@@ -90,6 +90,11 @@ SEAMS = frozenset(
         "replica.kill",   # fleet: kill the dispatch target mid-flight
         "replica.hang",   # fleet: wedge (SIGSTOP) the dispatch target
         "front.dispatch", # fleet: one front->replica dispatch attempt
+        # adaptive balance (ISSUE 15): crossed host-side each time the
+        # controller escalates to the steal collective; an injected fault
+        # degrades that round to the base action (the solve stays exact —
+        # balance only moves rows) and is counted in obs.balance
+        "balance.steal",
     }
 )
 
